@@ -1,0 +1,22 @@
+"""dask_sql_tpu: a TPU-native distributed SQL query engine.
+
+Brand-new implementation of the capability surface of dask-sql
+(/root/reference): a ``Context`` catalog + SQL entry point, a native SQL
+parser/planner with rule-based optimization, and a plugin-registry physical
+layer — lowering relational algebra to compiled JAX/XLA columnar kernels over
+mesh-sharded ``jax.Array`` tables instead of lazy Dask dataframe graphs.
+"""
+
+# SQL semantics need BIGINT/DOUBLE: enable 64-bit JAX before anything imports
+# jax.numpy.  (TPU-hot kernels downcast explicitly where it matters.)
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from .context import Context  # noqa: E402
+from .cmd import cmd_loop  # noqa: E402
+from .server.app import run_server  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = ["Context", "cmd_loop", "run_server", "__version__"]

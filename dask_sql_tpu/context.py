@@ -1,0 +1,3 @@
+"""Placeholder — real Context lands with the physical layer."""
+class Context:
+    pass

@@ -1,0 +1,2 @@
+def run_server(*a, **k):
+    raise NotImplementedError

@@ -1,0 +1,428 @@
+"""Columnar device tables: the TPU-native answer to the reference's DataContainer.
+
+The reference wraps a lazy dask DataFrame plus a frontend/backend column-name
+mapping (/root/reference/dask_sql/datacontainer.py:14-191) because renaming
+dask columns costs task-graph nodes.  Here a table is an ordered list of
+``Column`` objects, each wrapping one ``jax.Array`` on device; renames and
+projections are free dict surgery on the host, so no front/back mapping layer
+is needed — ``Table.rename``/``limit_to`` give the same API shape with O(1)
+cost.
+
+Null handling: every column may carry a boolean validity ``mask`` (True =
+valid).  TPUs have no NaN-for-int story and XLA wants uniform static buffers,
+so masks are explicit companion arrays, unlike the reference's pandas nullable
+dtypes (mappings.py:67-83).
+
+Strings are dictionary-encoded at ingestion: ``data`` holds int32 codes into a
+host-side numpy ``dictionary`` of unique values.  String kernels operate on
+the (small) dictionary on host and on codes on device — the TPU never touches
+variable-length bytes.  Code -1 is reserved for null strings' code slot (the
+mask is still authoritative).
+"""
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass, replace
+from typing import Any, Iterable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .types import (
+    SqlType,
+    BOOLEAN,
+    DOUBLE,
+    VARCHAR,
+    NULLTYPE,
+    physical_dtype,
+    physical_to_python_value,
+    python_value_to_physical,
+    sql_type_from_numpy,
+)
+
+
+# ---------------------------------------------------------------------------
+# Scalar
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Scalar:
+    """A typed SQL scalar in physical representation. ``value is None`` = NULL."""
+
+    value: Any
+    stype: SqlType
+
+    @property
+    def is_null(self) -> bool:
+        return self.value is None
+
+    def to_python(self):
+        return physical_to_python_value(self.value, self.stype)
+
+
+NULL = Scalar(None, NULLTYPE)
+
+
+# ---------------------------------------------------------------------------
+# Column
+# ---------------------------------------------------------------------------
+
+class Column:
+    """One device column: jax data + optional validity mask + logical type."""
+
+    __slots__ = ("data", "mask", "stype", "dictionary")
+
+    def __init__(
+        self,
+        data: jax.Array,
+        stype: SqlType,
+        mask: Optional[jax.Array] = None,
+        dictionary: Optional[np.ndarray] = None,
+    ):
+        self.data = data
+        self.stype = stype
+        self.mask = mask
+        self.dictionary = dictionary
+        if stype.is_string and dictionary is None:
+            raise ValueError("string columns require a dictionary")
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def from_numpy(values: np.ndarray, stype: Optional[SqlType] = None,
+                   mask: Optional[np.ndarray] = None) -> "Column":
+        values = np.asarray(values)
+        if stype is None:
+            stype = sql_type_from_numpy(values.dtype)
+        if values.dtype.kind in ("O", "U", "S") or stype.is_string:
+            return Column._encode_strings(values, mask)
+        if values.dtype.kind == "M":
+            vals = values.astype("datetime64[us]").astype(np.int64)
+            na = np.isnat(values)
+            if na.any():
+                mask = ~na if mask is None else (mask & ~na)
+            return Column(jnp.asarray(vals), stype, _as_mask(mask))
+        if values.dtype.kind == "m":
+            vals = values.astype("timedelta64[ms]").astype(np.int64)
+            na = np.isnat(values)
+            if na.any():
+                mask = ~na if mask is None else (mask & ~na)
+            return Column(jnp.asarray(vals), stype, _as_mask(mask))
+        if values.dtype.kind == "f" and mask is None:
+            # NaN means NULL on ingestion of plain float data?  No: keep NaN as
+            # NaN (the reference distinguishes them too); nulls only come from
+            # pandas NA masks.
+            pass
+        dtype = physical_dtype(stype)
+        return Column(jnp.asarray(values.astype(dtype, copy=False)), stype, _as_mask(mask))
+
+    @staticmethod
+    def _encode_strings(values: np.ndarray, mask: Optional[np.ndarray]) -> "Column":
+        values = np.asarray(values, dtype=object)
+        isna = np.array([v is None or (isinstance(v, float) and np.isnan(v)) for v in values])
+        safe = np.where(isna, "", values).astype(str)
+        dictionary, codes = np.unique(safe, return_inverse=True)
+        codes = codes.astype(np.int32)
+        if isna.any():
+            m = ~isna if mask is None else (np.asarray(mask, bool) & ~isna)
+        else:
+            m = mask
+        return Column(jnp.asarray(codes), VARCHAR, _as_mask(m), dictionary.astype(object))
+
+    @staticmethod
+    def from_scalar(scalar: Scalar, length: int) -> "Column":
+        stype = scalar.stype
+        if scalar.is_null:
+            if stype.name == "NULL":
+                stype = DOUBLE
+            data = jnp.zeros(length, dtype=physical_dtype(stype))
+            if stype.is_string:
+                return Column(data.astype(jnp.int32), stype,
+                              jnp.zeros(length, dtype=bool), np.array([""], dtype=object))
+            return Column(data, stype, jnp.zeros(length, dtype=bool))
+        if stype.is_string:
+            return Column(jnp.zeros(length, dtype=jnp.int32), stype, None,
+                          np.array([scalar.value], dtype=object))
+        return Column(jnp.full(length, scalar.value, dtype=physical_dtype(stype)), stype, None)
+
+    # -- basics ------------------------------------------------------------
+    def __len__(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def has_nulls(self) -> bool:
+        return self.mask is not None
+
+    def valid_mask(self) -> jax.Array:
+        """Always-materialized validity mask."""
+        if self.mask is None:
+            return jnp.ones(self.data.shape[0], dtype=bool)
+        return self.mask
+
+    def null_count(self) -> int:
+        if self.mask is None:
+            return 0
+        return int((~self.mask).sum())
+
+    def with_mask(self, mask: Optional[jax.Array]) -> "Column":
+        if mask is not None and bool(mask.all()):
+            mask = None
+        return Column(self.data, self.stype, mask, self.dictionary)
+
+    def cast_data(self, data: jax.Array, stype: Optional[SqlType] = None) -> "Column":
+        return Column(data, stype or self.stype, self.mask, self.dictionary)
+
+    def take(self, indices: jax.Array) -> "Column":
+        """Gather rows by position (device gather)."""
+        data = jnp.take(self.data, indices, axis=0)
+        mask = None if self.mask is None else jnp.take(self.mask, indices, axis=0)
+        return Column(data, self.stype, mask, self.dictionary)
+
+    def slice(self, start: int, stop: int) -> "Column":
+        data = self.data[start:stop]
+        mask = None if self.mask is None else self.mask[start:stop]
+        return Column(data, self.stype, mask, self.dictionary)
+
+    # -- dictionary helpers ------------------------------------------------
+    def decode(self) -> np.ndarray:
+        """Host numpy array of python objects (strings/None) for a string column."""
+        assert self.stype.is_string
+        codes = np.asarray(self.data)
+        out = self.dictionary[np.clip(codes, 0, len(self.dictionary) - 1)]
+        if self.mask is not None:
+            out = out.copy()
+            out[~np.asarray(self.mask)] = None
+        return out
+
+    def dict_ranks(self) -> "Column":
+        """Map codes to sort-order ranks so ORDER BY / comparisons work on device.
+
+        The dictionary produced at encode time is sorted (np.unique), but
+        derived columns can have unsorted dictionaries — compute rank array on
+        host (dictionary is small) and gather on device.
+        """
+        assert self.stype.is_string
+        order = np.argsort(self.dictionary.astype(str), kind="stable")
+        ranks = np.empty(len(order), dtype=np.int32)
+        ranks[order] = np.arange(len(order), dtype=np.int32)
+        data = jnp.take(jnp.asarray(ranks), jnp.clip(self.data, 0, len(ranks) - 1))
+        return Column(data, SqlType("INTEGER"), self.mask)
+
+    # -- host conversion ---------------------------------------------------
+    def to_numpy(self) -> np.ndarray:
+        """Host representation with rich types; nulls become None/NaN/NaT."""
+        n = self.stype.name
+        if self.stype.is_string:
+            return self.decode()
+        data = np.asarray(self.data)
+        if n == "DATE":
+            out = data.astype("datetime64[D]")
+            if self.mask is not None:
+                out[~np.asarray(self.mask)] = np.datetime64("NaT")
+            return out
+        if n in ("TIMESTAMP", "TIMESTAMP_WITH_LOCAL_TIME_ZONE"):
+            out = data.astype("datetime64[us]")
+            if self.mask is not None:
+                out[~np.asarray(self.mask)] = np.datetime64("NaT")
+            return out
+        if n == "INTERVAL_DAY_TIME":
+            out = data.astype("timedelta64[ms]")
+            if self.mask is not None:
+                out[~np.asarray(self.mask)] = np.timedelta64("NaT")
+            return out
+        if self.mask is not None:
+            if data.dtype.kind == "f":
+                out = data.copy()
+                out[~np.asarray(self.mask)] = np.nan
+                return out
+            # ints/bools with nulls -> object array with None
+            out = data.astype(object)
+            out[~np.asarray(self.mask)] = None
+            return out
+        return data
+
+    def to_pylist(self) -> list:
+        np_vals = self.to_numpy()
+        out = []
+        for v in np_vals.tolist():
+            out.append(v)
+        return out
+
+    def __repr__(self):
+        return f"Column({self.stype}, len={len(self)}, nulls={self.null_count()})"
+
+
+def _as_mask(mask) -> Optional[jax.Array]:
+    if mask is None:
+        return None
+    mask = np.asarray(mask, dtype=bool)
+    if mask.all():
+        return None
+    return jnp.asarray(mask)
+
+
+# ---------------------------------------------------------------------------
+# Table
+# ---------------------------------------------------------------------------
+
+class Table:
+    """An ordered, named collection of equal-length Columns."""
+
+    __slots__ = ("names", "columns")
+
+    def __init__(self, names: Sequence[str], columns: Sequence[Column]):
+        assert len(names) == len(columns)
+        self.names = list(names)
+        self.columns = list(columns)
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def from_pandas(df) -> "Table":
+        import pandas as pd
+
+        names, cols = [], []
+        for name in df.columns:
+            s = df[name]
+            names.append(str(name))
+            cols.append(_series_to_column(s))
+        return Table(names, cols)
+
+    @staticmethod
+    def from_pydict(data: dict) -> "Table":
+        names, cols = [], []
+        for k, v in data.items():
+            names.append(k)
+            if isinstance(v, Column):
+                cols.append(v)
+            else:
+                arr = np.asarray(v) if not _has_none(v) else np.asarray(v, dtype=object)
+                if arr.dtype.kind == "O" and not _all_strings(arr):
+                    arr2, mask = _denull(v)
+                    cols.append(Column.from_numpy(arr2, mask=mask))
+                else:
+                    cols.append(Column.from_numpy(arr))
+        return Table(names, cols)
+
+    # -- basics ------------------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        if not self.columns:
+            return 0
+        return len(self.columns[0])
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, name: str) -> Column:
+        return self.columns[self.names.index(name)]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.names
+
+    def limit_to(self, names: Iterable[str]) -> "Table":
+        """Project to a subset/reordering of columns (reference:
+        datacontainer.py:53 ColumnContainer.limit_to) — O(1), no device work."""
+        names = list(names)
+        return Table(names, [self.column(n) for n in names])
+
+    def rename(self, mapping: dict) -> "Table":
+        return Table([mapping.get(n, n) for n in self.names], self.columns)
+
+    def with_names(self, names: Sequence[str]) -> "Table":
+        assert len(names) == len(self.columns)
+        return Table(list(names), self.columns)
+
+    def add_column(self, name: str, col: Column) -> "Table":
+        return Table(self.names + [name], self.columns + [col])
+
+    def take(self, indices: jax.Array) -> "Table":
+        return Table(self.names, [c.take(indices) for c in self.columns])
+
+    def slice(self, start: int, stop: int) -> "Table":
+        return Table(self.names, [c.slice(start, stop) for c in self.columns])
+
+    def head(self, n: int) -> "Table":
+        return self.slice(0, min(n, self.num_rows))
+
+    def schema(self) -> list:
+        return list(zip(self.names, [c.stype for c in self.columns]))
+
+    # -- host conversion ---------------------------------------------------
+    def to_pandas(self):
+        import pandas as pd
+
+        data = {}
+        for name, col in zip(self.names, self.columns):
+            data[name] = col.to_numpy()
+        df = pd.DataFrame(data, columns=list(self.names))
+        return df
+
+    def to_pylist(self) -> list:
+        cols = [c.to_pylist() for c in self.columns]
+        return [list(row) for row in zip(*cols)] if cols else []
+
+    def __repr__(self):
+        parts = ", ".join(f"{n}: {c.stype}" for n, c in zip(self.names, self.columns))
+        return f"Table[{self.num_rows} rows]({parts})"
+
+
+_PANDAS_NULLABLE_NUMPY = {
+    "Int8": np.int8, "Int16": np.int16, "Int32": np.int32, "Int64": np.int64,
+    "UInt8": np.uint8, "UInt16": np.uint16, "UInt32": np.uint32, "UInt64": np.uint64,
+    "Float32": np.float32, "Float64": np.float64, "boolean": np.bool_,
+}
+
+
+def _series_to_column(s) -> Column:
+    import pandas as pd
+
+    dtype = s.dtype
+    # pandas nullable extension dtypes (Int64, boolean, Float64, ...)
+    if str(dtype) in _PANDAS_NULLABLE_NUMPY:
+        arr = s.array
+        mask = ~np.asarray(arr.isna())
+        vals = arr.to_numpy(dtype=_PANDAS_NULLABLE_NUMPY[str(dtype)], na_value=0)
+        return Column.from_numpy(vals, mask=mask if not mask.all() else None)
+    if str(dtype) in ("string", "str") or (
+        hasattr(pd, "StringDtype") and isinstance(dtype, pd.StringDtype)
+    ):
+        vals = s.to_numpy(dtype=object, na_value=None)
+        return Column.from_numpy(vals)
+    if isinstance(dtype, pd.CategoricalDtype):
+        cats = s.cat.categories.to_numpy(dtype=object)
+        codes = s.cat.codes.to_numpy().astype(np.int32)
+        mask = codes >= 0
+        if mask.all():
+            mask = None
+        return Column(jnp.asarray(np.where(codes < 0, 0, codes)), VARCHAR,
+                      _as_mask(mask), cats)
+    if dtype.kind == "M":
+        # tz-aware -> convert to UTC naive
+        if getattr(dtype, "tz", None) is not None:
+            s = s.dt.tz_convert("UTC").dt.tz_localize(None)
+        return Column.from_numpy(s.to_numpy())
+    if dtype == object:
+        return Column.from_numpy(s.to_numpy())
+    return Column.from_numpy(s.to_numpy())
+
+
+def _has_none(v) -> bool:
+    try:
+        return any(x is None for x in v)
+    except TypeError:
+        return False
+
+
+def _all_strings(arr) -> bool:
+    return all(isinstance(x, str) for x in arr.tolist())
+
+
+def _denull(v):
+    vals = list(v)
+    mask = np.array([x is not None for x in vals])
+    if all(isinstance(x, str) or x is None for x in vals):
+        arr = np.array(["" if x is None else x for x in vals], dtype=object)
+        return arr, mask
+    arr = np.array([0 if x is None else x for x in vals])
+    return arr, mask
