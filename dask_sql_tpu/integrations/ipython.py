@@ -1,0 +1,35 @@
+"""IPython %%sql magic (reference /root/reference/dask_sql/integrations/ipython.py).
+
+``auto_include=True`` scans the caller's namespace for pandas DataFrames and
+registers them as tables before each query (reference context.py:771-788).
+"""
+from __future__ import annotations
+
+
+def ipython_integration(context, auto_include: bool = False):
+    try:
+        from IPython.core.magic import register_line_cell_magic
+    except ImportError:
+        raise ImportError("IPython is not installed")
+
+    def sql(line, cell=None):
+        query = cell if cell is not None else line
+        if auto_include:
+            import pandas as pd
+            ip = _get_ipython()
+            if ip is not None:
+                for name, val in ip.user_ns.items():
+                    if isinstance(val, pd.DataFrame) and not name.startswith("_"):
+                        context.create_table(name, val)
+        return context.sql(query).to_pandas()
+
+    sql.__name__ = "sql"
+    register_line_cell_magic(sql)
+
+
+def _get_ipython():
+    try:
+        from IPython import get_ipython
+        return get_ipython()
+    except ImportError:
+        return None

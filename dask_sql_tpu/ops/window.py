@@ -1,0 +1,351 @@
+"""Window-function kernels: sharded segmented scans instead of gather-to-one.
+
+The reference collapses each PARTITION BY group to a single pandas partition
+via groupby().apply (/root/reference/dask_sql/physical/rel/logical/
+window.py:152-205) — a scalability cliff SURVEY §5 calls out.  Here windows
+are computed as sorted segmented scans: factorize partitions, lexsort by
+(partition, order keys), run prefix-scan kernels, scatter back to row order.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..table import Column, Scalar, Table
+from ..types import SqlType, physical_dtype
+from .kernels import comparable_data, factorize_columns
+
+
+def _segment_starts(codes_sorted: jax.Array) -> jax.Array:
+    n = codes_sorted.shape[0]
+    if n == 0:
+        return jnp.zeros(0, dtype=bool)
+    first = jnp.ones(1, dtype=bool)
+    rest = codes_sorted[1:] != codes_sorted[:-1]
+    return jnp.concatenate([first, rest])
+
+
+def _segment_ids(starts: jax.Array) -> jax.Array:
+    return jnp.cumsum(starts.astype(jnp.int64)) - 1
+
+
+def segmented_cumsum(x: jax.Array, starts: jax.Array) -> jax.Array:
+    """Inclusive prefix sum that resets at segment starts."""
+    total = jnp.cumsum(x)
+    seg = _segment_ids(starts)
+    start_pos = jnp.nonzero(starts, size=int(starts.sum()))[0]
+    base = jnp.where(start_pos > 0, total[jnp.maximum(start_pos - 1, 0)], 0)
+    return total - base[seg]
+
+
+def segmented_scan(x: jax.Array, starts: jax.Array, combine) -> jax.Array:
+    """Generic inclusive segmented scan via associative_scan on (flag, value)."""
+
+    def op(a, b):
+        fa, va = a
+        fb, vb = b
+        return (fa | fb, jnp.where(fb, vb, combine(va, vb)))
+
+    flags = starts
+    _, out = jax.lax.associative_scan(op, (flags, x))
+    return out
+
+
+def window_frame_sums(x: jax.Array, starts: jax.Array, seg: jax.Array,
+                      seg_start_pos: jax.Array, seg_end_pos: jax.Array,
+                      lo: Optional[int], hi: Optional[int]):
+    """Moving SUM/COUNT over ROWS frames using prefix sums.
+
+    lo/hi are row offsets relative to current (negative = preceding); None =
+    unbounded on that side.  All positions are within-sorted-order.
+    """
+    n = x.shape[0]
+    prefix = jnp.cumsum(x)
+    idx = jnp.arange(n)
+    start = seg_start_pos[seg] if lo is None else jnp.maximum(idx + lo, seg_start_pos[seg])
+    end = seg_end_pos[seg] if hi is None else jnp.minimum(idx + hi, seg_end_pos[seg])
+    end = jnp.minimum(end, n - 1)
+    start = jnp.maximum(start, 0)
+    upper = prefix[end]
+    lower = jnp.where(start > 0, prefix[jnp.maximum(start - 1, 0)], 0)
+    empty = end < start
+    return jnp.where(empty, 0, upper - lower)
+
+
+def compute_window(table: Table, op: str, arg_cols: List[int],
+                   partition_cols: List[int],
+                   order_keys: List[Tuple[int, bool, bool]],
+                   frame, stype: SqlType) -> Column:
+    """Compute one window call; returns a column aligned with table rows."""
+    n = table.num_rows
+    if n == 0:
+        return Column(jnp.zeros(0, dtype=physical_dtype(stype)), stype)
+
+    # 1. partition codes
+    if partition_cols:
+        codes, _, G = factorize_columns([table.columns[i] for i in partition_cols])
+    else:
+        codes = jnp.zeros(n, dtype=jnp.int64)
+        G = 1
+
+    # 2. sort by (partition, order keys)
+    arrays = []
+    for idx, asc, nulls_first in reversed(order_keys):
+        col = table.columns[idx]
+        data = comparable_data(col)
+        if jnp.issubdtype(data.dtype, jnp.integer):
+            data = data.astype(jnp.int64)
+        if not asc:
+            data = -data if not jnp.issubdtype(data.dtype, jnp.bool_) else ~data
+        if col.mask is not None:
+            nullkey = (~col.mask).astype(jnp.int8)
+            arrays.append(data)
+            arrays.append(nullkey if not nulls_first else -nullkey)
+        else:
+            arrays.append(data)
+    arrays.append(codes)
+    perm = jnp.lexsort(arrays)
+    inv_perm = jnp.zeros(n, dtype=jnp.int64).at[perm].set(jnp.arange(n))
+
+    scodes = codes[perm]
+    starts = _segment_starts(scodes)
+    seg = _segment_ids(starts)
+    nseg = int(scodes[-1] >= 0) and int(seg[-1]) + 1 if n else 0
+    nseg = int(seg[-1]) + 1 if n else 0
+    pos = jnp.arange(n)
+    seg_start_pos = jnp.zeros(nseg, dtype=jnp.int64).at[seg].min(pos) if n else jnp.zeros(0, jnp.int64)
+    seg_start_pos = jnp.full(nseg, n, dtype=jnp.int64).at[seg].min(pos)
+    seg_end_pos = jnp.zeros(nseg, dtype=jnp.int64).at[seg].max(pos)
+    row_in_seg = pos - seg_start_pos[seg]
+
+    # frame bounds as offsets
+    lo_off, hi_off = _frame_offsets(op, frame, bool(order_keys))
+
+    def scatter_back(sorted_vals, mask_sorted=None):
+        out = sorted_vals[inv_perm]
+        m = None if mask_sorted is None else mask_sorted[inv_perm]
+        return Column(out.astype(physical_dtype(stype)) if not stype.is_string else out,
+                      stype, m)
+
+    if op == "ROW_NUMBER":
+        return scatter_back(row_in_seg + 1)
+
+    if op in ("RANK", "DENSE_RANK", "PERCENT_RANK", "CUME_DIST"):
+        tie = _tie_starts(table, order_keys, perm, starts)
+        # rank: position of first row of the tie-group
+        tie_group_start = segmented_scan(
+            jnp.where(tie, pos, 0), starts | tie, jnp.maximum)
+        # propagate last tie start within segment
+        tie_start = segmented_scan(jnp.where(tie | starts, pos, -1), starts,
+                                   jnp.maximum)
+        rank = tie_start - seg_start_pos[seg] + 1
+        if op == "RANK":
+            return scatter_back(rank)
+        if op == "PERCENT_RANK":
+            seg_len = seg_end_pos[seg] - seg_start_pos[seg] + 1
+            pr = jnp.where(seg_len > 1, (rank - 1) / jnp.maximum(seg_len - 1, 1), 0.0)
+            return scatter_back(pr)
+        if op == "CUME_DIST":
+            seg_len = seg_end_pos[seg] - seg_start_pos[seg] + 1
+            # number of rows with order key <= current = end of tie group
+            is_last_of_tie = jnp.concatenate([tie[1:] | starts[1:], jnp.ones(1, bool)])
+            tie_end = _backward_fill_positions(pos, is_last_of_tie, seg, seg_end_pos)
+            return scatter_back((tie_end - seg_start_pos[seg] + 1) / seg_len)
+        # DENSE_RANK: count of tie-group starts up to here within segment
+        dr = segmented_cumsum((tie | starts).astype(jnp.int64), starts)
+        return scatter_back(dr)
+
+    if op == "NTILE":
+        k = int(np.asarray(table.columns[arg_cols[0]].data)[0]) if arg_cols else 1
+        seg_len = seg_end_pos[seg] - seg_start_pos[seg] + 1
+        out = (row_in_seg * k) // jnp.maximum(seg_len, 1) + 1
+        return scatter_back(out)
+
+    if op in ("LAG", "LEAD"):
+        col = table.columns[arg_cols[0]]
+        offset = 1
+        if len(arg_cols) > 1:
+            offset = int(np.asarray(table.columns[arg_cols[1]].data)[0])
+        shift = -offset if op == "LAG" else offset
+        src = pos + shift
+        valid = (src >= seg_start_pos[seg]) & (src <= seg_end_pos[seg])
+        src = jnp.clip(src, 0, n - 1)
+        sorted_col = col.take(perm)
+        gathered = sorted_col.take(src)
+        m = gathered.valid_mask() & valid
+        out = scatter_back(gathered.data, None if bool(m.all()) else m)
+        if col.stype.is_string:
+            return Column(out.data.astype(jnp.int32), stype, out.mask, col.dictionary)
+        return out
+
+    if op in ("FIRST_VALUE", "LAST_VALUE", "NTH_VALUE"):
+        col = table.columns[arg_cols[0]].take(perm)
+        if op == "FIRST_VALUE":
+            src = seg_start_pos[seg]
+        elif op == "LAST_VALUE":
+            # default frame = up to CURRENT ROW when ORDER BY present
+            if order_keys and frame is None:
+                src = pos
+            else:
+                src = seg_end_pos[seg]
+        else:
+            k = int(np.asarray(table.columns[arg_cols[1]].data)[0])
+            src = seg_start_pos[seg] + (k - 1)
+            src = jnp.minimum(src, seg_end_pos[seg])
+        gathered = col.take(src)
+        out = scatter_back(gathered.data,
+                           gathered.mask if gathered.mask is not None else None)
+        if col.stype.is_string:
+            return Column(out.data.astype(jnp.int32), stype, out.mask, col.dictionary)
+        return out
+
+    # aggregate window functions
+    if op in ("COUNT",):
+        if arg_cols:
+            col = table.columns[arg_cols[0]].take(perm)
+            x = col.valid_mask().astype(jnp.int64)
+        else:
+            x = jnp.ones(n, dtype=jnp.int64)
+        out = window_frame_sums(x, starts, seg, seg_start_pos, seg_end_pos,
+                                lo_off, hi_off)
+        return scatter_back(out)
+
+    if op in ("SUM", "$SUM0", "AVG"):
+        col = table.columns[arg_cols[0]].take(perm)
+        valid = col.valid_mask()
+        data = jnp.where(valid, col.data, 0)
+        if jnp.issubdtype(data.dtype, jnp.integer):
+            data = data.astype(jnp.int64)
+        else:
+            data = data.astype(jnp.float64)
+        s = window_frame_sums(data, starts, seg, seg_start_pos, seg_end_pos,
+                              lo_off, hi_off)
+        c = window_frame_sums(valid.astype(jnp.int64), starts, seg,
+                              seg_start_pos, seg_end_pos, lo_off, hi_off)
+        if op == "AVG":
+            out = s / jnp.maximum(c, 1)
+            return scatter_back(out, (c > 0))
+        if op == "$SUM0":
+            return scatter_back(s)
+        return scatter_back(s, None if bool((c > 0).all()) else (c > 0))
+
+    if op in ("MIN", "MAX"):
+        col = table.columns[arg_cols[0]].take(perm)
+        valid = col.valid_mask()
+        data = comparable_data(col)
+        if jnp.issubdtype(data.dtype, jnp.integer):
+            data = data.astype(jnp.int64)
+            sentinel = jnp.iinfo(jnp.int64).max if op == "MIN" else jnp.iinfo(jnp.int64).min
+        else:
+            data = data.astype(jnp.float64)
+            sentinel = jnp.inf if op == "MIN" else -jnp.inf
+        x = jnp.where(valid, data, sentinel)
+        combine = jnp.minimum if op == "MIN" else jnp.maximum
+        if lo_off is None and hi_off == 0:
+            out = segmented_scan(x, starts, combine)
+        elif lo_off is None and hi_off is None:
+            # whole partition: segment reduce then broadcast
+            total = jax.ops.segment_min(x, seg, nseg) if op == "MIN" else jax.ops.segment_max(x, seg, nseg)
+            out = total[seg]
+        else:
+            # bounded frame: windowed via per-offset shifts (frame sizes are
+            # small constants in practice)
+            lo = lo_off if lo_off is not None else -n
+            hi = hi_off if hi_off is not None else n
+            out = x
+            for d in range(lo, hi + 1):
+                if d == 0:
+                    continue
+                src = jnp.clip(pos + d, 0, n - 1)
+                ok = (pos + d >= seg_start_pos[seg]) & (pos + d <= seg_end_pos[seg])
+                out = combine(out, jnp.where(ok, x[src], sentinel))
+            in_frame_cnt = window_frame_sums(valid.astype(jnp.int64), starts, seg,
+                                             seg_start_pos, seg_end_pos, lo_off, hi_off)
+            m = in_frame_cnt > 0
+            if col.stype.is_string:
+                return _ranks_to_string(scatter_back(out, m), table.columns[arg_cols[0]], stype)
+            return scatter_back(out, None if bool(m.all()) else m)
+        c = window_frame_sums(valid.astype(jnp.int64), starts, seg,
+                              seg_start_pos, seg_end_pos, lo_off, hi_off)
+        m = c > 0
+        if col.stype.is_string:
+            return _ranks_to_string(scatter_back(out, None if bool(m.all()) else m),
+                                    table.columns[arg_cols[0]], stype)
+        return scatter_back(out, None if bool(m.all()) else m)
+
+    if op == "SINGLE_VALUE":
+        col = table.columns[arg_cols[0]].take(perm)
+        src = seg_start_pos[seg]
+        g = col.take(src)
+        out = scatter_back(g.data, g.mask)
+        if col.stype.is_string:
+            return Column(out.data.astype(jnp.int32), stype, out.mask, col.dictionary)
+        return out
+
+    raise NotImplementedError(f"Window function {op}")
+
+
+def _ranks_to_string(rank_col: Column, orig: Column, stype: SqlType) -> Column:
+    order = np.argsort(orig.dictionary.astype(str), kind="stable")
+    inv = jnp.asarray(order.astype(np.int64))
+    safe = jnp.clip(rank_col.data.astype(jnp.int64), 0, len(order) - 1)
+    codes = jnp.take(inv, safe).astype(jnp.int32)
+    return Column(codes, stype, rank_col.mask, orig.dictionary)
+
+
+def _frame_offsets(op: str, frame, has_order: bool):
+    """Map a frame spec to (lo, hi) row offsets (None = unbounded)."""
+    if frame is None:
+        if has_order and op not in ("ROW_NUMBER", "RANK", "DENSE_RANK"):
+            return None, 0          # default: UNBOUNDED PRECEDING .. CURRENT
+        return None, None           # whole partition
+    kind, lo, hi = frame
+    def conv(b, default):
+        tag, n = b
+        if tag == "UNBOUNDED_PRECEDING":
+            return None
+        if tag == "UNBOUNDED_FOLLOWING":
+            return None
+        if tag == "CURRENT":
+            return 0
+        if tag == "PRECEDING":
+            return -int(n)
+        return int(n)
+    lo_v = conv(lo, None)
+    hi_v = conv(hi, 0)
+    if lo[0] == "UNBOUNDED_FOLLOWING":
+        lo_v = None
+    return lo_v, hi_v
+
+
+def _tie_starts(table: Table, order_keys, perm, starts) -> jax.Array:
+    """True where the order-key value differs from the previous sorted row."""
+    n = int(perm.shape[0])
+    if not order_keys or n == 0:
+        return jnp.zeros(n, dtype=bool)
+    diff = jnp.zeros(n, dtype=bool)
+    for idx, _, _ in order_keys:
+        col = table.columns[idx]
+        data = comparable_data(col)[perm]
+        d = jnp.concatenate([jnp.zeros(1, bool), data[1:] != data[:-1]])
+        if col.mask is not None:
+            m = col.mask[perm]
+            dm = jnp.concatenate([jnp.zeros(1, bool), m[1:] != m[:-1]])
+            d = d | dm
+        diff = diff | d
+    return diff & ~starts
+
+
+def _backward_fill_positions(pos, is_last, seg, seg_end_pos):
+    """For each row, position of the last row of its tie group."""
+    n = pos.shape[0]
+    # reverse scan: propagate next is_last position backwards
+    rev = jnp.flip(jnp.where(is_last, pos, -1))
+    rev_filled = jax.lax.associative_scan(
+        lambda a, b: jnp.where(b >= 0, b, a), rev)
+    # associative_scan is forward; combined op keeps latest valid
+    filled = jnp.flip(rev_filled)
+    return jnp.where(filled >= 0, filled, seg_end_pos[seg])

@@ -1,0 +1,379 @@
+"""Rule-based heuristic optimizer.
+
+Reproduces the load-bearing effects of the reference's 17-rule HepPlanner
+program (/root/reference/planner/.../RelationalAlgebraGenerator.java:198-224):
+FILTER_INTO_JOIN / JOIN_CONDITION_PUSH (filter pushdown through projects and
+into join sides), PROJECT_MERGE / FILTER_MERGE, and projection pruning down to
+table scans (the effect of ProjectableFilterableTable + PROJECT rules).
+AVG/DISTINCT decompositions are unnecessary here — the segment-reduction
+kernels implement those aggregates directly.
+
+Passes are applied to fixpoint in a bounded loop; every pass is a pure
+RelNode -> RelNode function, so user rules can be appended to ``PASSES``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..types import BOOLEAN
+from .nodes import (
+    AggCall, Field, LogicalAggregate, LogicalExcept, LogicalFilter,
+    LogicalIntersect, LogicalJoin, LogicalProject, LogicalSample, LogicalSort,
+    LogicalTableScan, LogicalUnion, LogicalValues, LogicalWindow, RelNode,
+    RexCall, RexInputRef, RexLiteral, RexNode, RexScalarSubquery, RexUdf,
+    SortCollation, WindowCall, remap_rex, rex_inputs,
+)
+
+
+# ---------------------------------------------------------------------------
+# generic helpers
+# ---------------------------------------------------------------------------
+
+def _split_conjuncts(rex: RexNode) -> List[RexNode]:
+    if isinstance(rex, RexCall) and rex.op == "AND":
+        return _split_conjuncts(rex.operands[0]) + _split_conjuncts(rex.operands[1])
+    return [rex]
+
+
+def _and_all(rexes: List[RexNode]) -> Optional[RexNode]:
+    if not rexes:
+        return None
+    out = rexes[0]
+    for r in rexes[1:]:
+        out = RexCall("AND", [out, r], BOOLEAN)
+    return out
+
+
+def _is_pure(rex: RexNode) -> bool:
+    """True if the expression is deterministic & side-effect free (safe to
+    push/duplicate)."""
+    if isinstance(rex, (RexInputRef, RexLiteral)):
+        return True
+    if isinstance(rex, RexScalarSubquery):
+        return False
+    if isinstance(rex, RexUdf):
+        return False
+    if isinstance(rex, RexCall):
+        if rex.op in ("RAND", "RANDOM", "RAND_INTEGER"):
+            return False
+        return all(_is_pure(o) for o in rex.operands)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# pass: merge adjacent filters, drop TRUE filters
+# ---------------------------------------------------------------------------
+
+def merge_filters(rel: RelNode) -> RelNode:
+    rel = rel.with_inputs([merge_filters(i) for i in rel.inputs]) if rel.inputs else rel
+    if isinstance(rel, LogicalFilter):
+        if isinstance(rel.condition, RexLiteral) and rel.condition.value is True:
+            return rel.input
+        if isinstance(rel.input, LogicalFilter):
+            cond = RexCall("AND", [rel.input.condition, rel.condition], BOOLEAN)
+            return LogicalFilter(input=rel.input.input, condition=cond,
+                                 schema=rel.schema)
+    return rel
+
+
+# ---------------------------------------------------------------------------
+# pass: merge Project(Project) — PROJECT_MERGE
+# ---------------------------------------------------------------------------
+
+def _inline_rex(rex: RexNode, exprs: List[RexNode]) -> RexNode:
+    if isinstance(rex, RexInputRef):
+        return exprs[rex.index]
+    if isinstance(rex, RexCall):
+        return RexCall(rex.op, [_inline_rex(o, exprs) for o in rex.operands],
+                       rex.stype, rex.info)
+    if isinstance(rex, RexUdf):
+        return RexUdf(rex.name, rex.func, [_inline_rex(o, exprs) for o in rex.operands],
+                      rex.stype, rex.row_udf)
+    return rex
+
+
+def _rex_size(rex: RexNode) -> int:
+    if isinstance(rex, (RexCall, RexUdf)):
+        return 1 + sum(_rex_size(o) for o in rex.operands)
+    return 1
+
+
+def merge_projects(rel: RelNode) -> RelNode:
+    rel = rel.with_inputs([merge_projects(i) for i in rel.inputs]) if rel.inputs else rel
+    if isinstance(rel, LogicalProject) and isinstance(rel.input, LogicalProject):
+        inner = rel.input
+        if all(_is_pure(e) for e in inner.exprs):
+            new_exprs = [_inline_rex(e, inner.exprs) for e in rel.exprs]
+            # avoid exponential blowup from duplicating huge exprs
+            if sum(map(_rex_size, new_exprs)) <= 4 * (
+                sum(map(_rex_size, rel.exprs)) + sum(map(_rex_size, inner.exprs))
+            ):
+                return LogicalProject(input=inner.input, exprs=new_exprs,
+                                      schema=rel.schema)
+    return rel
+
+
+# ---------------------------------------------------------------------------
+# pass: push filters down — FILTER_INTO_JOIN / FILTER_PROJECT_TRANSPOSE /
+# FILTER_AGGREGATE_TRANSPOSE
+# ---------------------------------------------------------------------------
+
+def push_filters(rel: RelNode) -> RelNode:
+    if rel.inputs:
+        rel = rel.with_inputs([push_filters(i) for i in rel.inputs])
+    if not isinstance(rel, LogicalFilter):
+        return rel
+    child = rel.input
+    conjuncts = _split_conjuncts(rel.condition)
+
+    # -- through Project: rewrite refs via inlining (only pure exprs)
+    if isinstance(child, LogicalProject) and all(_is_pure(e) for e in child.exprs):
+        pushable = [c for c in conjuncts if _is_pure(c)]
+        stay = [c for c in conjuncts if not _is_pure(c)]
+        if pushable:
+            inner_cond = _and_all([_inline_rex(c, child.exprs) for c in pushable])
+            new_input = push_filters(LogicalFilter(
+                input=child.input, condition=inner_cond, schema=child.input.schema))
+            new_child = LogicalProject(input=new_input, exprs=child.exprs,
+                                       schema=child.schema)
+            if stay:
+                return LogicalFilter(input=new_child, condition=_and_all(stay),
+                                     schema=rel.schema)
+            return new_child
+
+    # -- into Join sides
+    if isinstance(child, LogicalJoin) and child.join_type in ("INNER", "LEFT", "RIGHT", "CROSS"):
+        nl = len(child.left.schema)
+        left_side, right_side, stay = [], [], []
+        for c in conjuncts:
+            refs = rex_inputs(c)
+            if not _is_pure(c):
+                stay.append(c)
+            elif all(r < nl for r in refs) and child.join_type in ("INNER", "LEFT", "CROSS"):
+                left_side.append(c)
+            elif all(r >= nl for r in refs) and child.join_type in ("INNER", "RIGHT", "CROSS"):
+                right_side.append(c)
+            else:
+                stay.append(c)
+        if left_side or right_side:
+            new_left, new_right = child.left, child.right
+            if left_side:
+                new_left = push_filters(LogicalFilter(
+                    input=child.left, condition=_and_all(left_side),
+                    schema=child.left.schema))
+            if right_side:
+                shifted = [remap_rex(c, {i: i - nl for i in rex_inputs(c)})
+                           for c in right_side]
+                new_right = push_filters(LogicalFilter(
+                    input=child.right, condition=_and_all(shifted),
+                    schema=child.right.schema))
+            new_join = LogicalJoin(left=new_left, right=new_right,
+                                   join_type=child.join_type,
+                                   condition=child.condition, schema=child.schema)
+            if stay:
+                return LogicalFilter(input=new_join, condition=_and_all(stay),
+                                     schema=rel.schema)
+            return new_join
+
+    # -- through Aggregate: conjuncts that only touch group keys
+    if isinstance(child, LogicalAggregate):
+        n_keys = len(child.group_keys)
+        pushable, stay = [], []
+        for c in conjuncts:
+            refs = rex_inputs(c)
+            if _is_pure(c) and all(r < n_keys for r in refs):
+                pushable.append(c)
+            else:
+                stay.append(c)
+        if pushable:
+            mapping = {i: child.group_keys[i] for i in range(n_keys)}
+            inner = _and_all([remap_rex(c, mapping) for c in pushable])
+            new_input = push_filters(LogicalFilter(
+                input=child.input, condition=inner, schema=child.input.schema))
+            new_agg = LogicalAggregate(input=new_input, group_keys=child.group_keys,
+                                       aggs=child.aggs, schema=child.schema)
+            if stay:
+                return LogicalFilter(input=new_agg, condition=_and_all(stay),
+                                     schema=rel.schema)
+            return new_agg
+
+    return rel
+
+
+# ---------------------------------------------------------------------------
+# pass: extract equi conditions from join residuals into the condition
+# (JOIN_CONDITION_PUSH is implicit: our executor splits equi pairs itself)
+# ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
+# pass: column pruning down to TableScan
+# ---------------------------------------------------------------------------
+
+def prune_columns(rel: RelNode) -> RelNode:
+    new_rel, _ = _prune(rel, set(range(len(rel.schema))))
+    return new_rel
+
+
+def _identity_map(n: int) -> Dict[int, int]:
+    return {i: i for i in range(n)}
+
+
+def _prune(rel: RelNode, needed: Set[int]) -> Tuple[RelNode, Dict[int, int]]:
+    """Returns (new_rel, mapping old_ordinal -> new_ordinal).
+
+    ``needed`` are the output ordinals the parent requires; a node may keep
+    more.  Mapping covers at least ``needed``.
+    """
+    if isinstance(rel, LogicalTableScan):
+        keep = sorted(needed) if needed else list(range(min(1, len(rel.schema))))
+        if not keep:
+            keep = [0] if rel.schema else []
+        new_schema = [rel.schema[i] for i in keep]
+        mapping = {o: i for i, o in enumerate(keep)}
+        return LogicalTableScan(rel.schema_name, rel.table_name, new_schema), mapping
+
+    if isinstance(rel, LogicalProject):
+        keep = sorted(needed) if needed else [0]
+        child_needed: Set[int] = set()
+        for i in keep:
+            child_needed.update(rex_inputs(rel.exprs[i]))
+        new_child, cmap = _prune(rel.input, child_needed)
+        new_exprs = [remap_rex(rel.exprs[i], cmap) for i in keep]
+        new_schema = [rel.schema[i] for i in keep]
+        mapping = {o: i for i, o in enumerate(keep)}
+        return LogicalProject(new_child, new_exprs, new_schema), mapping
+
+    if isinstance(rel, LogicalFilter):
+        child_needed = set(needed) | set(rex_inputs(rel.condition))
+        new_child, cmap = _prune(rel.input, child_needed)
+        cond = remap_rex(rel.condition, cmap)
+        keep = sorted(needed) if needed else sorted(cmap.keys())
+        exprs = [RexInputRef(cmap[i], rel.schema[i].stype) for i in keep]
+        new_schema = [rel.schema[i] for i in keep]
+        if sorted(cmap.keys()) == keep and all(cmap[k] == j for j, k in enumerate(keep)):
+            return LogicalFilter(new_child, cond, new_schema), {o: i for i, o in enumerate(keep)}
+        filt = LogicalFilter(new_child, cond, list(new_child.schema))
+        proj = LogicalProject(filt, exprs, new_schema)
+        return proj, {o: i for i, o in enumerate(keep)}
+
+    if isinstance(rel, LogicalAggregate):
+        n_keys = len(rel.group_keys)
+        used_aggs = sorted(i - n_keys for i in needed if i >= n_keys)
+        child_needed = set(rel.group_keys)
+        for ai in used_aggs:
+            child_needed.update(rel.aggs[ai].args)
+            if rel.aggs[ai].filter_arg is not None:
+                child_needed.add(rel.aggs[ai].filter_arg)
+        new_child, cmap = _prune(rel.input, child_needed)
+        new_keys = [cmap[k] for k in rel.group_keys]
+        new_aggs = []
+        for ai in used_aggs:
+            a = rel.aggs[ai]
+            new_aggs.append(AggCall(a.op, [cmap[x] for x in a.args], a.distinct,
+                                    a.stype, a.name,
+                                    cmap[a.filter_arg] if a.filter_arg is not None else None,
+                                    a.udaf))
+        new_schema = rel.schema[:n_keys] + [rel.schema[n_keys + ai] for ai in used_aggs]
+        mapping = {i: i for i in range(n_keys)}
+        for j, ai in enumerate(used_aggs):
+            mapping[n_keys + ai] = n_keys + j
+        return LogicalAggregate(new_child, new_keys, new_aggs, new_schema), mapping
+
+    if isinstance(rel, LogicalJoin):
+        nl = len(rel.left.schema)
+        cond_refs = set(rex_inputs(rel.condition)) if rel.condition is not None else set()
+        all_needed = set(needed) | cond_refs
+        left_needed = {i for i in all_needed if i < nl}
+        right_needed = {i - nl for i in all_needed if i >= nl}
+        new_left, lmap = _prune(rel.left, left_needed)
+        new_right, rmap = _prune(rel.right, right_needed)
+        new_nl = len(new_left.schema)
+        mapping = {}
+        for o, n in lmap.items():
+            mapping[o] = n
+        for o, n in rmap.items():
+            mapping[nl + o] = new_nl + n
+        cond = remap_rex(rel.condition, mapping) if rel.condition is not None else None
+        if rel.join_type in ("SEMI", "ANTI"):
+            new_schema = [rel.schema[i] for i in sorted(lmap.keys())]
+        else:
+            new_schema = ([rel.schema[i] for i in sorted(lmap.keys())] +
+                          [rel.schema[nl + i] for i in sorted(rmap.keys())])
+        out = LogicalJoin(new_left, new_right, rel.join_type, cond, new_schema)
+        if hasattr(rel, "null_aware"):
+            out.null_aware = rel.null_aware  # type: ignore[attr-defined]
+        return out, mapping
+
+    if isinstance(rel, LogicalSort):
+        child_needed = set(needed) | {c.index for c in rel.collation}
+        new_child, cmap = _prune(rel.input, child_needed)
+        coll = [SortCollation(cmap[c.index], c.ascending, c.nulls_first)
+                for c in rel.collation]
+        new_schema = [rel.schema[i] for i in sorted(cmap.keys())]
+        # schema must mirror child schema ordering
+        inv = sorted(cmap.keys())
+        new_schema = [rel.schema[i] for i in inv]
+        return LogicalSort(new_child, coll, rel.limit, rel.offset, new_schema), cmap
+
+    if isinstance(rel, LogicalWindow):
+        n_in = len(rel.input.schema)
+        used_calls = sorted(i - n_in for i in needed if i >= n_in)
+        child_needed = {i for i in needed if i < n_in}
+        for ci in used_calls:
+            c = rel.calls[ci]
+            child_needed.update(c.args)
+            child_needed.update(c.partition)
+            child_needed.update(k.index for k in c.order)
+        new_child, cmap = _prune(rel.input, child_needed)
+        new_calls = []
+        for ci in used_calls:
+            c = rel.calls[ci]
+            new_calls.append(WindowCall(
+                c.op, [cmap[a] for a in c.args], [cmap[p] for p in c.partition],
+                [SortCollation(cmap[k.index], k.ascending, k.nulls_first)
+                 for k in c.order], c.frame, c.stype, c.name))
+        new_schema = list(new_child.schema) + [rel.schema[n_in + ci] for ci in used_calls]
+        mapping = dict(cmap)
+        for j, ci in enumerate(used_calls):
+            mapping[n_in + ci] = len(new_child.schema) + j
+        return LogicalWindow(new_child, new_calls, new_schema), mapping
+
+    if isinstance(rel, (LogicalUnion, LogicalIntersect, LogicalExcept)):
+        # set ops need all columns (row identity)
+        new_inputs = []
+        for i in rel.inputs_:
+            ni, _ = _prune(i, set(range(len(i.schema))))
+            new_inputs.append(ni)
+        out = rel.with_inputs(new_inputs)
+        return out, _identity_map(len(rel.schema))
+
+    if isinstance(rel, LogicalSample):
+        new_child, cmap = _prune(rel.input, needed)
+        new_schema = [f for f in new_child.schema]
+        return LogicalSample(new_child, rel.method, rel.percentage, rel.seed,
+                             new_schema), cmap
+
+    # default: require everything below, identity above
+    if rel.inputs:
+        new_inputs = []
+        for i in rel.inputs:
+            ni, imap = _prune(i, set(range(len(i.schema))))
+            new_inputs.append(ni)
+        rel = rel.with_inputs(new_inputs)
+    return rel, _identity_map(len(rel.schema))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+PASSES = [merge_filters, push_filters, merge_filters, merge_projects]
+
+
+def optimize(plan: RelNode, enable_pruning: bool = True) -> RelNode:
+    for p in PASSES:
+        plan = p(plan)
+    if enable_pruning:
+        plan = prune_columns(plan)
+        plan = merge_projects(plan)
+    return plan

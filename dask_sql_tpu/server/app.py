@@ -1,2 +1,229 @@
-def run_server(*a, **k):
-    raise NotImplementedError
+"""Presto-wire-protocol HTTP server.
+
+Re-implements the reference server (/root/reference/dask_sql/server/app.py):
+``POST /v1/statement`` submits SQL, ``GET /v1/status/{uuid}`` polls,
+``DELETE /v1/cancel/{uuid}`` cancels, ``GET /v1/empty`` returns an empty
+result — with async execution via a thread pool + futures registry mirroring
+the reference's dask-client future_list (app.py:69-95).
+
+Built on stdlib http.server (FastAPI/uvicorn are not in this image); the wire
+format matches the reference's responses.py so presto/trino clients work.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import uuid as uuid_mod
+from concurrent.futures import Future, ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# presto wire responses (reference server/responses.py)
+# ---------------------------------------------------------------------------
+
+def _stats(state: str) -> dict:
+    """Placeholder stats, parity with reference responses.py:11-49."""
+    return {
+        "state": state, "queued": False, "scheduled": False, "nodes": 0,
+        "totalSplits": 0, "queuedSplits": 0, "runningSplits": 0,
+        "completedSplits": 0, "cpuTimeMillis": 0, "wallTimeMillis": 0,
+        "queuedTimeMillis": 0, "elapsedTimeMillis": 0, "processedRows": 0,
+        "processedBytes": 0, "peakMemoryBytes": 0,
+    }
+
+
+_TYPE_MAP = {
+    "BOOLEAN": "boolean", "TINYINT": "tinyint", "SMALLINT": "smallint",
+    "INTEGER": "integer", "BIGINT": "bigint", "FLOAT": "real",
+    "DOUBLE": "double", "DECIMAL": "decimal", "VARCHAR": "varchar",
+    "CHAR": "char", "DATE": "date", "TIMESTAMP": "timestamp",
+    "TIME": "time", "INTERVAL_DAY_TIME": "interval day to second",
+    "INTERVAL_YEAR_MONTH": "interval year to month", "NULL": "unknown",
+}
+
+
+def _columns_payload(table) -> list:
+    cols = []
+    for name, col in zip(table.names, table.columns):
+        t = _TYPE_MAP.get(col.stype.name, "varchar")
+        cols.append({
+            "name": name, "type": t,
+            "typeSignature": {"rawType": t, "arguments": []},
+        })
+    return cols
+
+
+def _data_payload(table) -> list:
+    rows = []
+    for row in table.to_pylist():
+        out = []
+        for v in row:
+            if hasattr(v, "isoformat"):
+                v = v.isoformat(sep=" ") if hasattr(v, "date") else v.isoformat()
+            elif hasattr(v, "item"):
+                v = v.item()
+            out.append(v)
+        rows.append(out)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class _AppState:
+    def __init__(self, context):
+        self.context = context
+        self.pool = ThreadPoolExecutor(max_workers=4)
+        self.future_list: Dict[str, Future] = {}
+        self.lock = threading.Lock()
+
+
+def _make_handler(state: _AppState, base_url: str):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            logger.debug("server: " + fmt, *args)
+
+        def _send(self, code: int, payload: Optional[dict]):
+            body = json.dumps(payload or {}).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        # GET /v1/empty  |  GET /v1/status/{uuid}
+        def do_GET(self):
+            if self.path.rstrip("/") == "/v1/empty":
+                self._send(200, {
+                    "id": "empty", "infoUri": base_url,
+                    "columns": [], "data": [], "stats": _stats("FINISHED"),
+                })
+                return
+            if self.path.startswith("/v1/status/"):
+                uid = self.path[len("/v1/status/"):].strip("/")
+                fut = state.future_list.get(uid)
+                if fut is None:
+                    self._send(404, _error_payload("Unknown query id", uid))
+                    return
+                if not fut.done():
+                    self._send(200, {
+                        "id": uid, "infoUri": base_url,
+                        "nextUri": f"{base_url}/v1/status/{uid}",
+                        "partialCancelUri": f"{base_url}/v1/cancel/{uid}",
+                        "stats": _stats("RUNNING"),
+                    })
+                    return
+                try:
+                    table = fut.result()
+                except Exception as e:
+                    del state.future_list[uid]
+                    self._send(200, _error_payload(str(e), uid))
+                    return
+                del state.future_list[uid]
+                payload = {
+                    "id": uid, "infoUri": base_url, "stats": _stats("FINISHED"),
+                }
+                if table is not None and table.num_columns:
+                    payload["columns"] = _columns_payload(table)
+                    payload["data"] = _data_payload(table)
+                self._send(200, payload)
+                return
+            self._send(404, {"error": "not found"})
+
+        # POST /v1/statement
+        def do_POST(self):
+            if self.path.rstrip("/") != "/v1/statement":
+                self._send(404, {"error": "not found"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            sql = self.rfile.read(length).decode()
+            uid = str(uuid_mod.uuid4())
+            fut = state.pool.submit(state.context.sql, sql)
+            state.future_list[uid] = fut
+            self._send(200, {
+                "id": uid, "infoUri": base_url,
+                "nextUri": f"{base_url}/v1/status/{uid}",
+                "partialCancelUri": f"{base_url}/v1/cancel/{uid}",
+                "stats": _stats("QUEUED"),
+            })
+
+        # DELETE /v1/cancel/{uuid}
+        def do_DELETE(self):
+            if self.path.startswith("/v1/cancel/"):
+                uid = self.path[len("/v1/cancel/"):].strip("/")
+                fut = state.future_list.pop(uid, None)
+                if fut is None:
+                    self._send(404, _error_payload("Unknown query id", uid))
+                    return
+                fut.cancel()
+                self._send(200, None)
+                return
+            self._send(404, {"error": "not found"})
+
+    return Handler
+
+
+def _error_payload(message: str, uid: str) -> dict:
+    """reference responses.py:119-139 ErrorResults shape."""
+    return {
+        "id": uid, "infoUri": "", "stats": _stats("FAILED"),
+        "error": {
+            "message": message, "errorCode": 1,
+            "errorName": "GENERIC_ERROR", "errorType": "USER_ERROR",
+            "errorLocation": {"lineNumber": 1, "columnNumber": 1},
+        },
+    }
+
+
+def run_server(context=None, host: str = "0.0.0.0", port: int = 8080,
+               startup: bool = False, log_level=None, blocking: bool = True):
+    """Start the SQL server (reference server/app.py:97-183).
+
+    With ``blocking=False`` returns the (started) server object for tests.
+    """
+    if log_level:
+        logging.basicConfig(level=log_level)
+    from ..context import Context
+
+    context = context or Context()
+    if startup:
+        context.sql("SELECT 1 + 1")
+
+    state = _AppState(context)
+    base_url = f"http://{host}:{port}"
+    server = ThreadingHTTPServer((host, port), _make_handler(state, base_url))
+    server.app_state = state
+    context.server = server
+    if not blocking:
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        return server
+    try:
+        logger.info("dask-sql-tpu server listening on %s", base_url)
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return server
+
+
+def main():  # pragma: no cover - console entry
+    import argparse
+
+    parser = argparse.ArgumentParser(description="dask-sql-tpu presto server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--startup", action="store_true")
+    parser.add_argument("--log-level", default=None)
+    args = parser.parse_args()
+    run_server(host=args.host, port=args.port, startup=args.startup,
+               log_level=args.log_level)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -108,11 +108,13 @@ class Column:
             if na.any():
                 mask = ~na if mask is None else (mask & ~na)
             return Column(jnp.asarray(vals), stype, _as_mask(mask))
-        if values.dtype.kind == "f" and mask is None:
-            # NaN means NULL on ingestion of plain float data?  No: keep NaN as
-            # NaN (the reference distinguishes them too); nulls only come from
-            # pandas NA masks.
-            pass
+        if values.dtype.kind == "f":
+            # NaN means NULL on ingestion (pandas semantics: the reference's
+            # dask frames treat NaN as missing, mappings.py:67-83)
+            na = np.isnan(values)
+            if na.any():
+                mask = ~na if mask is None else (np.asarray(mask, bool) & ~na)
+                values = np.where(na, 0.0, values)
         dtype = physical_dtype(stype)
         return Column(jnp.asarray(values.astype(dtype, copy=False)), stype, _as_mask(mask))
 
