@@ -71,7 +71,7 @@ _DOUBLE_RESULT = {
 }
 
 _SAME_AS_ARG = {"NEGATE", "ABS", "FLOOR", "CEIL", "CEILING", "ROUND",
-                "TRUNCATE", "TRUNC"}
+                "TRUNCATE", "TRUNC", "SIGN"}
 
 
 def infer_call_type(op: str, arg_types: List[SqlType]) -> SqlType:

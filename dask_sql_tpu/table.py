@@ -232,6 +232,13 @@ class Column:
             if self.mask is not None:
                 out[~np.asarray(self.mask)] = np.timedelta64("NaT")
             return out
+        if n == "TIME":
+            from .types import physical_to_python_value
+            vals = [physical_to_python_value(int(v), self.stype) for v in data.tolist()]
+            out = np.array(vals, dtype=object)
+            if self.mask is not None:
+                out[~np.asarray(self.mask)] = None
+            return out
         if self.mask is not None:
             if data.dtype.kind == "f":
                 out = data.copy()

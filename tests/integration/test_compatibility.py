@@ -1,0 +1,150 @@
+"""SQLite differential-oracle tests.
+
+The reference's most important test pattern (SURVEY §4): run the same SQL
+through the engine and through in-memory sqlite3 and compare frames
+(/root/reference/tests/integration/test_compatibility.py:22-67, with
+make_rand_df seeded generators).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.conftest import eq_sqlite, make_rand_df
+
+
+def test_basic_select():
+    a = make_rand_df(30, a=int, b=float, c=str)
+    eq_sqlite("SELECT a, b, c FROM a", a=a)
+    eq_sqlite("SELECT a+1 AS a1, b*2 AS b2 FROM a", a=a)
+
+
+def test_where():
+    a = make_rand_df(30, a=(int, 5), b=(float, 5), c=(str, 5))
+    eq_sqlite("SELECT * FROM a WHERE a < 5", a=a)
+    eq_sqlite("SELECT * FROM a WHERE a < 5 AND b > 2", a=a)
+    eq_sqlite("SELECT * FROM a WHERE a IS NULL OR b > 2", a=a)
+    eq_sqlite("SELECT * FROM a WHERE c IS NOT NULL", a=a)
+
+
+def test_arithmetic():
+    a = make_rand_df(20, a=int, b=float)
+    eq_sqlite("SELECT a+b AS x, a-b AS y, a*b AS z, b/2 AS w FROM a", a=a)
+    eq_sqlite("SELECT -a AS na, ABS(a-5) AS ab FROM a", a=a)
+
+
+def test_case_when():
+    a = make_rand_df(30, a=(int, 5), b=(float, 5))
+    eq_sqlite(
+        """SELECT CASE WHEN a IS NULL THEN -1 WHEN a < 5 THEN a*10 ELSE b END AS x
+           FROM a""", a=a)
+
+
+def test_group_by_agg():
+    a = make_rand_df(50, a=(int, 10), b=(float, 10), c=(str, 10))
+    eq_sqlite(
+        """SELECT c, SUM(a) AS sa, COUNT(*) AS n, COUNT(a) AS ca,
+                  AVG(b) AS ab, MIN(a) AS mi, MAX(a) AS ma
+           FROM a GROUP BY c""", a=a)
+
+
+def test_group_by_multiple_keys():
+    a = make_rand_df(60, a=(int, 10), c=(str, 10), d=(str, 10))
+    eq_sqlite("SELECT c, d, COUNT(*) AS n, SUM(a) AS s FROM a GROUP BY c, d", a=a)
+
+
+def test_distinct():
+    a = make_rand_df(50, a=(int, 10), c=(str, 10))
+    eq_sqlite("SELECT DISTINCT a, c FROM a", a=a)
+    eq_sqlite("SELECT COUNT(DISTINCT a) AS n FROM a", a=a)
+
+
+def test_order_by_limit():
+    a = make_rand_df(40, a=(int, 5), b=float, c=(str, 5))
+    eq_sqlite("SELECT * FROM a ORDER BY b LIMIT 10", check_row_order=True, a=a)
+    eq_sqlite("SELECT * FROM a ORDER BY a NULLS FIRST, b DESC LIMIT 10",
+              check_row_order=True, a=a)
+    eq_sqlite("SELECT * FROM a ORDER BY c NULLS LAST, b LIMIT 5 OFFSET 3",
+              check_row_order=True, a=a)
+
+
+def test_join_inner():
+    a = make_rand_df(30, k=int, va=float)
+    b = make_rand_df(20, k=int, vb=float)
+    eq_sqlite("SELECT a.k, va, vb FROM a JOIN b ON a.k = b.k", a=a, b=b)
+
+
+def test_join_left():
+    a = make_rand_df(30, k=(int, 5), va=float)
+    b = make_rand_df(20, k=(int, 3), vb=float)
+    eq_sqlite("SELECT a.k, va, vb FROM a LEFT JOIN b ON a.k = b.k", a=a, b=b)
+
+
+def test_join_multi_key():
+    a = make_rand_df(40, k1=int, k2=(str, 5), va=float)
+    b = make_rand_df(30, k1=int, k2=(str, 5), vb=float)
+    eq_sqlite(
+        """SELECT a.k1, a.k2, va, vb FROM a
+           JOIN b ON a.k1 = b.k1 AND a.k2 = b.k2""", a=a, b=b)
+
+
+def test_union_compat():
+    a = make_rand_df(20, a=int, b=str)
+    b = make_rand_df(20, a=int, b=str)
+    eq_sqlite("SELECT * FROM a UNION SELECT * FROM b", a=a, b=b)
+    eq_sqlite("SELECT * FROM a UNION ALL SELECT * FROM b", a=a, b=b)
+    eq_sqlite("SELECT * FROM a EXCEPT SELECT * FROM b", a=a, b=b)
+    eq_sqlite("SELECT * FROM a INTERSECT SELECT * FROM b", a=a, b=b)
+
+
+def test_in_subquery():
+    a = make_rand_df(30, k=int, v=float)
+    b = make_rand_df(10, k=int)
+    eq_sqlite("SELECT * FROM a WHERE k IN (SELECT k FROM b)", a=a, b=b)
+    eq_sqlite("SELECT * FROM a WHERE k NOT IN (SELECT k FROM b)", a=a, b=b)
+
+
+def test_scalar_subquery_compat():
+    a = make_rand_df(30, k=int, v=float)
+    eq_sqlite("SELECT * FROM a WHERE v > (SELECT AVG(v) FROM a)", a=a)
+
+
+def test_having_compat():
+    a = make_rand_df(50, g=(str, 5), v=float)
+    eq_sqlite(
+        "SELECT g, SUM(v) AS s FROM a GROUP BY g HAVING COUNT(*) > 5", a=a)
+
+
+def test_string_funcs_compat():
+    a = make_rand_df(30, s=(str, 5))
+    eq_sqlite("SELECT UPPER(s) AS u, LOWER(s) AS l, LENGTH(s) AS n FROM a", a=a)
+    eq_sqlite("SELECT * FROM a WHERE s LIKE 's1%'", a=a)
+
+
+def test_cte_compat():
+    a = make_rand_df(30, k=int, v=float)
+    eq_sqlite(
+        """WITH big AS (SELECT * FROM a WHERE v > 5),
+                agg AS (SELECT k, COUNT(*) AS n FROM big GROUP BY k)
+           SELECT * FROM agg""", a=a)
+
+
+def test_window_compat():
+    a = make_rand_df(30, g=(str, 3), v=float)
+    eq_sqlite(
+        """SELECT g, v,
+                  ROW_NUMBER() OVER (PARTITION BY g ORDER BY v) AS r,
+                  SUM(v) OVER (PARTITION BY g ORDER BY v) AS s
+           FROM a""", a=a)
+
+
+def test_complex_query():
+    a = make_rand_df(60, g=(str, 10), k=int, v=(float, 10))
+    b = make_rand_df(20, k=int, w=float)
+    eq_sqlite(
+        """SELECT a.g, COUNT(*) AS n, SUM(a.v * b.w) AS dot
+           FROM a JOIN b ON a.k = b.k
+           WHERE a.v IS NOT NULL
+           GROUP BY a.g
+           HAVING COUNT(*) > 1
+           ORDER BY dot DESC
+           LIMIT 5""", check_row_order=False, a=a, b=b)

@@ -1,0 +1,79 @@
+"""CREATE TABLE tests (reference: tests/integration/test_create.py)."""
+import os
+import tempfile
+
+import pandas as pd
+import pytest
+
+from tests.conftest import assert_eq
+
+
+@pytest.fixture()
+def temporary_data_file():
+    path = os.path.join(tempfile.gettempdir(), os.urandom(12).hex() + ".csv")
+    yield path
+    if os.path.exists(path):
+        os.unlink(path)
+
+
+def test_create_from_csv(c, df_simple, temporary_data_file):
+    df_simple.to_csv(temporary_data_file, index=False)
+    c.sql(f"""CREATE TABLE new_table WITH (
+               location = '{temporary_data_file}', format = 'csv')""")
+    assert_eq(c.sql("SELECT * FROM new_table"), df_simple)
+
+
+def test_create_from_csv_persist(c, df_simple, temporary_data_file):
+    df_simple.to_csv(temporary_data_file, index=False)
+    c.sql(f"""CREATE TABLE new_table WITH (
+               location = '{temporary_data_file}', format = 'csv', persist = True)""")
+    assert_eq(c.sql("SELECT * FROM new_table"), df_simple)
+
+
+def test_wrong_create(c):
+    with pytest.raises(AttributeError):
+        c.sql("CREATE TABLE new_table WITH (format = 'csv')")
+    with pytest.raises(AttributeError):
+        c.sql("CREATE TABLE new_table WITH (format = 'strange', location = 'x')")
+
+
+def test_create_from_query(c, df_simple):
+    c.sql("CREATE TABLE new_table AS (SELECT a + 1 AS a FROM df_simple)")
+    assert_eq(c.sql("SELECT * FROM new_table"),
+              pd.DataFrame({"a": df_simple["a"] + 1}))
+    c.sql("CREATE OR REPLACE TABLE new_table AS (SELECT a - 1 AS a FROM df_simple)")
+    assert_eq(c.sql("SELECT * FROM new_table"),
+              pd.DataFrame({"a": df_simple["a"] - 1}))
+    with pytest.raises(RuntimeError):
+        c.sql("CREATE TABLE new_table AS (SELECT a FROM df_simple)")
+    c.sql("CREATE TABLE IF NOT EXISTS new_table AS (SELECT a FROM df_simple)")
+
+
+def test_create_view(c, df_simple):
+    c.sql("CREATE VIEW my_view AS (SELECT a + 1 AS a FROM df_simple)")
+    assert_eq(c.sql("SELECT * FROM my_view"),
+              pd.DataFrame({"a": df_simple["a"] + 1}))
+    # views are lazy: they see updates to the underlying table
+    c.sql("CREATE OR REPLACE TABLE df_simple AS (SELECT 10 AS a, 1.0 AS b)")
+    assert_eq(c.sql("SELECT * FROM my_view"), pd.DataFrame({"a": [11]}))
+
+
+def test_drop_table(c, df_simple):
+    c.create_table("to_drop", df_simple)
+    c.sql("DROP TABLE to_drop")
+    from dask_sql_tpu.utils import ParsingException
+    with pytest.raises(ParsingException):
+        c.sql("SELECT * FROM to_drop")
+    with pytest.raises(RuntimeError):
+        c.sql("DROP TABLE to_drop")
+    c.sql("DROP TABLE IF EXISTS to_drop")
+
+
+def test_create_from_parquet(c, df_simple, temporary_data_file):
+    path = temporary_data_file.replace(".csv", ".parquet")
+    df_simple.to_parquet(path)
+    try:
+        c.sql(f"CREATE TABLE pq_table WITH (location = '{path}')")
+        assert_eq(c.sql("SELECT * FROM pq_table"), df_simple)
+    finally:
+        os.unlink(path)

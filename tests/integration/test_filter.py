@@ -1,0 +1,61 @@
+"""WHERE tests (reference: tests/integration/test_filter.py)."""
+import pandas as pd
+
+from tests.conftest import assert_eq
+
+
+def test_filter(c, df):
+    assert_eq(c.sql("SELECT * FROM df WHERE a < 2"), df[df["a"] < 2])
+
+
+def test_filter_scalar(c, df):
+    assert_eq(c.sql("SELECT * FROM df WHERE True"), df)
+    assert_eq(c.sql("SELECT * FROM df WHERE False"), df.head(0))
+    assert_eq(c.sql("SELECT * FROM df WHERE (1 = 1)"), df)
+    assert_eq(c.sql("SELECT * FROM df WHERE (1 = 0)"), df.head(0))
+
+
+def test_filter_complicated(c, df):
+    expected = df[((df["a"] < 3) & ((df["b"] > 1) & (df["b"] < 3)))]
+    assert_eq(c.sql("SELECT * FROM df WHERE a < 3 AND (b > 1 AND b < 3)"), expected)
+
+
+def test_filter_with_nan(c, user_table_nan):
+    result = c.sql("SELECT * FROM user_table_nan WHERE c = 3").to_pandas()
+    assert list(result["c"]) == [3]
+
+
+def test_string_filter(c, string_table):
+    assert_eq(
+        c.sql("SELECT * FROM string_table WHERE a = 'a normal string'"),
+        string_table.head(1),
+    )
+
+
+def test_filter_or(c, df):
+    expected = df[(df["a"] < 2) | (df["b"] > 9)]
+    assert_eq(c.sql("SELECT * FROM df WHERE a < 2 OR b > 9"), expected)
+
+
+def test_filter_not(c, df):
+    expected = df[~(df["a"] < 2)]
+    assert_eq(c.sql("SELECT * FROM df WHERE NOT a < 2"), expected)
+
+
+def test_filter_between(c, df):
+    expected = df[df["b"].between(2, 4)]
+    assert_eq(c.sql("SELECT * FROM df WHERE b BETWEEN 2 AND 4"), expected)
+
+
+def test_filter_in(c, user_table_1):
+    expected = user_table_1[user_table_1["user_id"].isin([1, 3])]
+    assert_eq(
+        c.sql("SELECT * FROM user_table_1 WHERE user_id IN (1, 3)"),
+        expected, check_row_order=False,
+    )
+
+
+def test_filter_null_propagation(c, user_table_nan):
+    # NULL comparisons are filtered out (three-valued logic)
+    result = c.sql("SELECT * FROM user_table_nan WHERE c > 0").to_pandas()
+    assert sorted(result["c"]) == [1, 3]

@@ -1,0 +1,77 @@
+"""UDF / custom aggregation tests (reference: tests/integration/test_function.py)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.conftest import assert_eq
+
+
+def test_custom_function(c, df):
+    def f(x):
+        return x**2
+
+    c.register_function(f, "f", [("x", np.float64)], np.float64)
+    result = c.sql("SELECT F(b) AS f FROM df")
+    assert_eq(result, pd.DataFrame({"f": df["b"] ** 2}))
+
+
+def test_custom_function_two_args(c, df):
+    def f(x, y):
+        return x + y
+
+    c.register_function(f, "f", [("x", np.float64), ("y", np.float64)], np.float64)
+    result = c.sql("SELECT F(a, b) AS f FROM df")
+    assert_eq(result, pd.DataFrame({"f": df["a"] + df["b"]}))
+
+
+def test_custom_function_row_udf(c, df_simple):
+    def f(row):
+        return row["a0"] + row["a1"]
+
+    c.register_function(f, "rowf", [("x", np.int64), ("y", np.float64)],
+                        np.float64, row_udf=True)
+    result = c.sql("SELECT rowf(a, b) AS f FROM df_simple")
+    assert_eq(result, pd.DataFrame({"f": df_simple["a"] + df_simple["b"]}))
+
+
+def test_replace_function(c, df):
+    def f(x):
+        return x
+
+    def g(x):
+        return x + 1
+
+    c.register_function(f, "h", [("x", np.float64)], np.float64)
+    with pytest.raises(ValueError):
+        c.register_function(g, "h", [("x", np.float64)], np.float64)
+    c.register_function(g, "h", [("x", np.float64)], np.float64, replace=True)
+    result = c.sql("SELECT h(b) AS f FROM df")
+    assert_eq(result, pd.DataFrame({"f": df["b"] + 1}))
+
+
+def test_custom_aggregation(c, user_table_1):
+    def f(s):
+        return s.max() - s.min()
+
+    c.register_aggregation(f, "span", [("x", np.int64)], np.int64)
+    result = c.sql(
+        "SELECT user_id, span(b) AS s FROM user_table_1 GROUP BY user_id")
+    g = user_table_1.groupby("user_id")["b"]
+    expected = (g.max() - g.min()).reset_index().rename(columns={"b": "s"})
+    expected.columns = ["user_id", "s"]
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_udf_with_literal(c, df):
+    def addn(x, n):
+        return x + n
+
+    c.register_function(addn, "addn", [("x", np.float64), ("n", np.int64)], np.float64)
+    result = c.sql("SELECT addn(b, 2) AS f FROM df")
+    assert_eq(result, pd.DataFrame({"f": df["b"] + 2}))
+
+
+def test_unknown_function_raises(c, df):
+    from dask_sql_tpu.utils import ParsingException
+    with pytest.raises(ParsingException):
+        c.sql("SELECT nosuchfunction(b) FROM df")

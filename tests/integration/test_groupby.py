@@ -1,0 +1,127 @@
+"""GROUP BY tests (reference: tests/integration/test_groupby.py)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.conftest import assert_eq
+
+
+def test_group_by(c, user_table_1):
+    result = c.sql(
+        "SELECT user_id, SUM(b) AS S FROM user_table_1 GROUP BY user_id")
+    expected = (user_table_1.groupby("user_id")["b"].sum()
+                .reset_index().rename(columns={"b": "S"}))
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_group_by_all(c, df):
+    result = c.sql("SELECT SUM(b) AS S, SUM(2*b) AS S2 FROM df")
+    expected = pd.DataFrame({"S": [df["b"].sum()], "S2": [2 * df["b"].sum()]})
+    assert_eq(result, expected)
+
+
+def test_group_by_filtered(c, user_table_1):
+    result = c.sql(
+        """SELECT user_id,
+                  SUM(b) FILTER (WHERE user_id = 2) AS "S1",
+                  SUM(b) AS "S2"
+           FROM user_table_1 GROUP BY user_id""")
+    expected = pd.DataFrame({
+        "user_id": [1, 2, 3],
+        "S1": [np.nan, 4.0, np.nan],
+        "S2": [3, 4, 3],
+    })
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_group_by_case(c, user_table_1):
+    result = c.sql(
+        """SELECT user_id + 1 AS "u", SUM(CASE WHEN b = 3 THEN 1 ELSE 0 END) AS "S"
+           FROM user_table_1 GROUP BY user_id + 1""")
+    expected = pd.DataFrame({"u": [2, 3, 4], "S": [1, 1, 1]})
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_group_by_nan(c):
+    frame = pd.DataFrame({"c": [3, float("nan"), 1], "d": [1, 2, 3]})
+    c.create_table("nan_df", frame)
+    result = c.sql("SELECT c, SUM(d) AS s FROM nan_df GROUP BY c").to_pandas()
+    # NULL forms its own group (SQL GROUP BY semantics)
+    assert len(result) == 3
+
+
+def test_aggregations(c, user_table_1):
+    result = c.sql(
+        """SELECT user_id,
+                  AVG(b) AS "a", SUM(b) AS "s", COUNT(b) AS "c",
+                  MIN(b) AS "mi", MAX(b) AS "ma",
+                  EVERY(b = 3) AS "e", BIT_AND(b) AS "ba", BIT_OR(b) AS "bo",
+                  SINGLE_VALUE(user_id) AS "sv", ANY_VALUE(b) AS "av"
+           FROM user_table_1 GROUP BY user_id""").to_pandas()
+    g = user_table_1.groupby("user_id")["b"]
+    expected = pd.DataFrame({
+        "user_id": g.mean().index,
+        "a": g.mean().values, "s": g.sum().values, "c": g.count().values,
+        "mi": g.min().values, "ma": g.max().values,
+        "e": g.apply(lambda s: bool((s == 3).all())).values,
+        "ba": g.apply(lambda s: np.bitwise_and.reduce(s.values)).values,
+        "bo": g.apply(lambda s: np.bitwise_or.reduce(s.values)).values,
+        "sv": g.mean().index,
+        "av": g.first().values,
+    })
+    assert_eq(result.sort_values("user_id").reset_index(drop=True),
+              expected.reset_index(drop=True))
+
+
+def test_stats_aggregation(c, user_table_1):
+    result = c.sql(
+        """SELECT user_id,
+                  STDDEV(b) AS "std", VAR_SAMP(b) AS "vs",
+                  STDDEV_POP(b) AS "sp", VAR_POP(b) AS "vp"
+           FROM user_table_1 GROUP BY user_id""").to_pandas().sort_values("user_id")
+    g = user_table_1.groupby("user_id")["b"]
+    np.testing.assert_allclose(result["std"].values, g.std().values, rtol=1e-9, equal_nan=True)
+    np.testing.assert_allclose(result["vs"].values, g.var().values, rtol=1e-9, equal_nan=True)
+    np.testing.assert_allclose(result["sp"].values, g.std(ddof=0).values, rtol=1e-9)
+    np.testing.assert_allclose(result["vp"].values, g.var(ddof=0).values, rtol=1e-9)
+
+
+def test_group_by_distinct(c, user_table_1):
+    result = c.sql(
+        """SELECT user_id, COUNT(DISTINCT b) AS "cd", SUM(DISTINCT b) AS "sd"
+           FROM user_table_1 GROUP BY user_id""")
+    g = user_table_1.groupby("user_id")["b"]
+    expected = pd.DataFrame({
+        "user_id": g.nunique().index,
+        "cd": g.nunique().values,
+        "sd": g.apply(lambda s: s.drop_duplicates().sum()).values,
+    })
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_count_star(c, long_table):
+    result = c.sql("SELECT a, COUNT(*) AS n FROM long_table GROUP BY a")
+    expected = long_table.groupby("a").size().reset_index(name="n")
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_having(c, user_table_1):
+    result = c.sql(
+        "SELECT user_id, SUM(b) AS s FROM user_table_1 GROUP BY user_id HAVING SUM(b) > 3")
+    expected = pd.DataFrame({"user_id": [2], "s": [4]})
+    assert_eq(result, expected)
+
+
+def test_group_by_null(c, user_table_nan):
+    result = c.sql(
+        "SELECT c, COUNT(*) AS n FROM user_table_nan GROUP BY c").to_pandas()
+    assert len(result) == 3
+
+
+def test_groupby_ordinal_and_alias(c, user_table_1):
+    r1 = c.sql("SELECT user_id AS u, SUM(b) AS s FROM user_table_1 GROUP BY 1")
+    r2 = c.sql("SELECT user_id AS u, SUM(b) AS s FROM user_table_1 GROUP BY u")
+    expected = (user_table_1.groupby("user_id")["b"].sum().reset_index()
+                .rename(columns={"user_id": "u", "b": "s"}))
+    assert_eq(r1, expected, check_row_order=False)
+    assert_eq(r2, expected, check_row_order=False)

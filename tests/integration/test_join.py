@@ -1,0 +1,156 @@
+"""JOIN tests (reference: tests/integration/test_join.py)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.conftest import assert_eq
+
+
+def test_join(c, user_table_1, user_table_2):
+    result = c.sql(
+        """SELECT lhs.user_id, lhs.b, rhs.c
+           FROM user_table_1 AS lhs JOIN user_table_2 AS rhs
+           ON lhs.user_id = rhs.user_id""")
+    expected = user_table_1.merge(user_table_2, on="user_id")[["user_id", "b", "c"]]
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_join_inner(c, user_table_1, user_table_2):
+    result = c.sql(
+        """SELECT lhs.user_id, lhs.b, rhs.c
+           FROM user_table_1 AS lhs INNER JOIN user_table_2 AS rhs
+           ON lhs.user_id = rhs.user_id""")
+    expected = user_table_1.merge(user_table_2, on="user_id")[["user_id", "b", "c"]]
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_join_outer(c, user_table_1, user_table_2):
+    result = c.sql(
+        """SELECT lhs.user_id, lhs.b, rhs.c
+           FROM user_table_1 AS lhs FULL JOIN user_table_2 AS rhs
+           ON lhs.user_id = rhs.user_id""")
+    expected = user_table_1.merge(user_table_2, on="user_id", how="outer")[
+        ["user_id", "b", "c"]]
+    # SQL semantics: lhs.user_id is NULL for right-only rows (pandas merge
+    # coalesces the key; SQL does not)
+    expected.loc[expected["b"].isna(), "user_id"] = np.nan
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_join_left(c, user_table_1, user_table_2):
+    result = c.sql(
+        """SELECT lhs.user_id, lhs.b, rhs.c
+           FROM user_table_1 AS lhs LEFT JOIN user_table_2 AS rhs
+           ON lhs.user_id = rhs.user_id""")
+    expected = user_table_1.merge(user_table_2, on="user_id", how="left")[
+        ["user_id", "b", "c"]]
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_join_right(c, user_table_1, user_table_2):
+    result = c.sql(
+        """SELECT rhs.user_id, lhs.b, rhs.c
+           FROM user_table_1 AS lhs RIGHT JOIN user_table_2 AS rhs
+           ON lhs.user_id = rhs.user_id""")
+    expected = user_table_1.merge(user_table_2, on="user_id", how="right")[
+        ["user_id", "b", "c"]]
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_join_cross(c, user_table_1, df_simple):
+    result = c.sql(
+        "SELECT user_id, lhs.b, a FROM user_table_1 AS lhs, df_simple AS rhs")
+    expected = user_table_1.merge(df_simple[["a"]], how="cross")[["user_id", "b", "a"]]
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_join_complex(c, df_simple):
+    result = c.sql(
+        """SELECT lhs.a, rhs.b
+           FROM df_simple AS lhs JOIN df_simple AS rhs
+           ON lhs.a < rhs.b""")
+    lhs = df_simple.rename(columns={"b": "lb"})
+    rhs = df_simple.rename(columns={"a": "ra"})
+    expected = lhs.merge(rhs, how="cross")
+    expected = expected[expected["a"] < expected["b"]][["a", "b"]]
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_join_equi_plus_residual(c, user_table_lk, user_table_ts):
+    # equality + inequality condition (reference test pattern with lk tables)
+    result = c.sql(
+        """SELECT ts.dates, ts.ts_nullint, lk.id
+           FROM user_table_ts ts JOIN user_table_lk lk
+           ON lk.id = 1 AND ts.dates >= lk.startdate""")
+    lk = user_table_lk[user_table_lk["id"] == 1]
+    expected = user_table_ts.merge(lk, how="cross")
+    expected = expected[expected["dates"] >= expected["startdate"]][
+        ["dates", "ts_nullint", "id"]]
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_join_on_nan(c):
+    left = pd.DataFrame({"k": [1.0, np.nan, 2.0], "v": [1, 2, 3]})
+    right = pd.DataFrame({"k": [1.0, np.nan], "w": [10, 20]})
+    c.create_table("jl", left)
+    c.create_table("jr", right)
+    result = c.sql("SELECT jl.v, jr.w FROM jl JOIN jr ON jl.k = jr.k").to_pandas()
+    # NULL keys never match (SQL semantics)
+    assert len(result) == 1
+    assert result["v"][0] == 1 and result["w"][0] == 10
+
+
+def test_join_usage_counts(c, user_table_1, user_table_2):
+    # many-to-many expansion
+    result = c.sql(
+        """SELECT lhs.user_id FROM user_table_1 lhs
+           JOIN user_table_2 rhs ON lhs.user_id = rhs.user_id""").to_pandas()
+    expected = user_table_1.merge(user_table_2, on="user_id")
+    assert len(result) == len(expected)
+
+
+def test_join_using(c, user_table_1, user_table_2):
+    result = c.sql(
+        "SELECT * FROM user_table_1 JOIN user_table_2 USING (user_id)").to_pandas()
+    expected = user_table_1.merge(user_table_2, on="user_id")
+    # USING hides the duplicate column in star expansion
+    assert list(result.columns) == ["user_id", "b", "c"]
+    assert len(result) == len(expected)
+
+
+def test_semi_join_via_in(c, user_table_1, user_table_2):
+    result = c.sql(
+        """SELECT * FROM user_table_1
+           WHERE user_id IN (SELECT user_id FROM user_table_2)""")
+    expected = user_table_1[user_table_1["user_id"].isin(user_table_2["user_id"])]
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_anti_join_via_not_in(c, user_table_1, user_table_2):
+    result = c.sql(
+        """SELECT * FROM user_table_1
+           WHERE user_id NOT IN (SELECT user_id FROM user_table_2)""")
+    expected = user_table_1[~user_table_1["user_id"].isin(user_table_2["user_id"])]
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_exists(c, user_table_1, user_table_2):
+    result = c.sql(
+        """SELECT * FROM user_table_1
+           WHERE EXISTS (SELECT 1 FROM user_table_2 WHERE c > 100)""").to_pandas()
+    assert len(result) == 0
+
+
+def test_scalar_subquery(c, user_table_1):
+    result = c.sql(
+        "SELECT * FROM user_table_1 WHERE b < (SELECT AVG(b) FROM user_table_1)")
+    expected = user_table_1[user_table_1["b"] < user_table_1["b"].mean()]
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_self_join(c, user_table_1):
+    result = c.sql(
+        """SELECT a.user_id FROM user_table_1 a
+           JOIN user_table_1 b ON a.user_id = b.user_id""").to_pandas()
+    expected = user_table_1.merge(user_table_1, on="user_id")
+    assert len(result) == len(expected)

@@ -1,0 +1,115 @@
+"""Window function tests (reference: tests/integration/test_over.py)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.conftest import assert_eq
+
+
+def test_over_with_sorting(c, user_table_1):
+    result = c.sql(
+        """SELECT user_id, b,
+                  ROW_NUMBER() OVER (ORDER BY user_id, b) AS "R"
+           FROM user_table_1""")
+    expected = user_table_1.copy()
+    expected["R"] = (user_table_1.sort_values(["user_id", "b"]).index.argsort() + 1)
+    expected["R"] = user_table_1.assign(
+        _r=np.argsort(np.lexsort((user_table_1["b"], user_table_1["user_id"]))) + 1
+    )["_r"]
+    assert_eq(result, expected)
+
+
+def test_over_with_partitioning(c, user_table_2):
+    result = c.sql(
+        """SELECT user_id, c,
+                  ROW_NUMBER() OVER (PARTITION BY c ORDER BY user_id) AS "R"
+           FROM user_table_2""")
+    expected = user_table_2.copy()
+    expected["R"] = user_table_2.groupby("c")["user_id"].rank(method="first").astype(int)
+    assert_eq(result, expected)
+
+
+def test_over_with_grouping_and_sort(c, user_table_1):
+    result = c.sql(
+        """SELECT user_id, b,
+                  ROW_NUMBER() OVER (PARTITION BY user_id ORDER BY b) AS "R"
+           FROM user_table_1""")
+    expected = user_table_1.copy()
+    expected["R"] = user_table_1.groupby("user_id")["b"].rank(method="first").astype(int)
+    assert_eq(result, expected)
+
+
+def test_over_with_different(c, user_table_1):
+    result = c.sql(
+        """SELECT user_id, b,
+                  ROW_NUMBER() OVER (PARTITION BY user_id ORDER BY b) AS "R1",
+                  ROW_NUMBER() OVER (ORDER BY user_id, b) AS "R2"
+           FROM user_table_1""").to_pandas()
+    expected = user_table_1.copy()
+    expected["R1"] = user_table_1.groupby("user_id")["b"].rank(method="first").astype(int)
+    expected["R2"] = np.argsort(np.lexsort((user_table_1["b"], user_table_1["user_id"]))) + 1
+    assert_eq(result, expected)
+
+
+def test_over_calls(c, user_table_1):
+    result = c.sql(
+        """SELECT user_id, b,
+            FIRST_VALUE(user_id*10 - b) OVER (PARTITION BY user_id ORDER BY b) AS "F",
+            SUM(b) OVER (PARTITION BY user_id ORDER BY b) AS "S",
+            AVG(b) OVER (PARTITION BY user_id ORDER BY b) AS "A",
+            COUNT(*) OVER (PARTITION BY user_id ORDER BY b) AS "C",
+            MAX(b) OVER (PARTITION BY user_id ORDER BY b) AS "M"
+           FROM user_table_1""").to_pandas()
+    df2 = user_table_1.sort_values(["user_id", "b"]).copy()
+    g = df2.groupby("user_id")
+    first_vals = (df2["user_id"] * 10 - df2["b"]).groupby(df2["user_id"]).transform("first")
+    df2["F"] = first_vals
+    df2["S"] = g["b"].cumsum()
+    df2["A"] = g["b"].expanding().mean().reset_index(level=0, drop=True)
+    df2["C"] = g.cumcount() + 1
+    df2["M"] = g["b"].cummax()
+    expected = df2.loc[user_table_1.index].reset_index(drop=True)
+    assert_eq(result, expected[["user_id", "b", "F", "S", "A", "C", "M"]])
+
+
+def test_over_with_windows(c):
+    frame = pd.DataFrame({"a": range(5)})
+    c.create_table("tmp", frame)
+    result = c.sql(
+        """SELECT a,
+            SUM(a) OVER (ORDER BY a ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) AS "S1",
+            SUM(a) OVER (ORDER BY a ROWS BETWEEN 2 PRECEDING AND 1 FOLLOWING) AS "S2",
+            SUM(a) OVER (ORDER BY a ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS "S3",
+            SUM(a) OVER (ORDER BY a ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING) AS "S4"
+           FROM tmp""").to_pandas()
+    a = frame["a"]
+    assert list(result["S1"]) == list(a.rolling(3, min_periods=1).sum().astype(int))
+    expected_s2 = [a[max(0, i - 2): i + 2].sum() for i in range(5)]
+    assert list(result["S2"]) == expected_s2
+    assert list(result["S3"]) == list(a.cumsum())
+    assert list(result["S4"]) == [a.sum()] * 5
+
+
+def test_rank_functions(c, user_table_1):
+    result = c.sql(
+        """SELECT user_id, b,
+                  RANK() OVER (PARTITION BY user_id ORDER BY b) AS "r",
+                  DENSE_RANK() OVER (PARTITION BY user_id ORDER BY b) AS "dr"
+           FROM user_table_1""").to_pandas()
+    df = user_table_1
+    expected_r = df.groupby("user_id")["b"].rank(method="min").astype(int)
+    expected_dr = df.groupby("user_id")["b"].rank(method="dense").astype(int)
+    assert list(result["r"]) == list(expected_r)
+    assert list(result["dr"]) == list(expected_dr)
+
+
+def test_lag_lead(c):
+    frame = pd.DataFrame({"g": [1, 1, 1, 2, 2], "v": [10, 20, 30, 40, 50]})
+    c.create_table("ll", frame)
+    result = c.sql(
+        """SELECT g, v,
+                  LAG(v) OVER (PARTITION BY g ORDER BY v) AS "lag1",
+                  LEAD(v) OVER (PARTITION BY g ORDER BY v) AS "lead1"
+           FROM ll""").to_pandas()
+    assert list(result["lag1"].fillna(-1)) == [-1, 10, 20, -1, 40]
+    assert list(result["lead1"].fillna(-1)) == [20, 30, -1, 50, -1]

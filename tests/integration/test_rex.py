@@ -1,0 +1,214 @@
+"""Scalar expression tests (reference: tests/integration/test_rex.py)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.conftest import assert_eq
+
+
+def test_case(c, df):
+    result = c.sql(
+        """SELECT
+            CASE WHEN a = 3 THEN 1 END AS "S1",
+            CASE WHEN a > 0 THEN a ELSE 1 END AS "S2",
+            CASE WHEN a = 4 THEN 3 ELSE a + 1 END AS "S3",
+            CASE WHEN a = 3 THEN 1 WHEN a > 0 THEN 2 ELSE a END AS "S4",
+            CASE a WHEN 1 THEN 10 WHEN 2 THEN 20 ELSE 30 END AS "S5"
+        FROM df""").to_pandas()
+    a = df["a"]
+    expected = pd.DataFrame({
+        "S1": a.where(a == 3, np.nan).where(a != 3, 1.0),
+        "S2": a.where(a > 0, 1),
+        "S3": (a + 1).where(a != 4, 3),
+        "S4": a.where(a != 3, 1).where((a == 3) | (a <= 0), 2),
+        "S5": a.map({1: 10, 2: 20}).fillna(30),
+    })
+    assert_eq(result, expected)
+
+
+def test_literal_null(c):
+    result = c.sql("SELECT NULL AS n, 1 + NULL AS m").to_pandas()
+    assert result["n"].isna().all()
+    assert result["m"].isna().all()
+
+
+def test_boolean_operations(c):
+    frame = pd.DataFrame({"b": pd.array([True, False, None], dtype="boolean")})
+    c.create_table("bools", frame)
+    result = c.sql(
+        """SELECT b IS TRUE AS t, b IS FALSE AS f, b IS NOT TRUE AS nt,
+                  b IS NOT FALSE AS nf, b IS NULL AS i, NOT b AS n
+           FROM bools""").to_pandas()
+    assert list(result["t"]) == [True, False, False]
+    assert list(result["f"]) == [False, True, False]
+    assert list(result["nt"]) == [False, True, True]
+    assert list(result["nf"]) == [True, False, True]
+    assert list(result["i"]) == [False, False, True]
+    assert result["n"][0] == False and result["n"][1] == True and pd.isna(result["n"][2])
+
+
+def test_math_operations(c, df):
+    result = c.sql(
+        """SELECT ABS(b - 5) AS "abs", ROUND(b, 1) AS "round", FLOOR(b) AS "floor",
+                  CEIL(b) AS "ceil", SQRT(b) AS "sqrt", SIGN(b - 5) AS "sign"
+           FROM df""").to_pandas()
+    b = df["b"]
+    np.testing.assert_allclose(result["abs"], (b - 5).abs(), rtol=1e-12)
+    np.testing.assert_allclose(result["round"], b.round(1), rtol=1e-12)
+    np.testing.assert_allclose(result["floor"], np.floor(b), rtol=1e-12)
+    np.testing.assert_allclose(result["ceil"], np.ceil(b), rtol=1e-12)
+    np.testing.assert_allclose(result["sqrt"], np.sqrt(b), rtol=1e-12)
+    np.testing.assert_allclose(result["sign"], np.sign(b - 5), rtol=1e-12)
+
+
+def test_trigonometry(c, df):
+    result = c.sql(
+        """SELECT SIN(b) AS s, COS(b) AS co, TAN(b) AS t, ATAN(b) AS at
+           FROM df""").to_pandas()
+    b = df["b"]
+    np.testing.assert_allclose(result["s"], np.sin(b), rtol=1e-12)
+    np.testing.assert_allclose(result["co"], np.cos(b), rtol=1e-12)
+    np.testing.assert_allclose(result["t"], np.tan(b), rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(result["at"], np.arctan(b), rtol=1e-12)
+
+
+def test_integer_div(c, df_simple):
+    result = c.sql("SELECT a / 2 AS d, a / -2 AS dn, 7 % a AS m FROM df_simple").to_pandas()
+    # SQL integer division truncates toward zero
+    assert list(result["d"]) == [0, 1, 1]
+    assert list(result["dn"]) == [0, -1, -1]
+    assert list(result["m"]) == [0, 1, 1]
+
+
+def test_string_functions(c, string_table):
+    result = c.sql(
+        """SELECT
+            a || 'hello' || a AS "a",
+            CHAR_LENGTH(a) AS "c",
+            UPPER(a) AS "u", LOWER(a) AS "l",
+            SUBSTRING(a FROM 2 FOR 2) AS "s",
+            POSITION('a' IN a) AS "p",
+            TRIM('a' FROM a) AS "t",
+            OVERLAY(a PLACING 'XXX' FROM 2) AS "o",
+            INITCAP(a) AS "i",
+            REPLACE(a, 'nor', 'NOR') AS "r"
+        FROM string_table""").to_pandas()
+    s = string_table["a"]
+    assert list(result["a"]) == [x + "hello" + x for x in s]
+    assert list(result["c"]) == [len(x) for x in s]
+    assert list(result["u"]) == [x.upper() for x in s]
+    assert list(result["l"]) == [x.lower() for x in s]
+    assert list(result["s"]) == [x[1:3] for x in s]
+    assert list(result["p"]) == [x.find("a") + 1 for x in s]
+    assert list(result["t"]) == [x.strip("a") for x in s]
+    assert list(result["o"]) == [x[:1] + "XXX" + x[4:] for x in s]
+    assert list(result["r"]) == [x.replace("nor", "NOR") for x in s]
+
+
+def test_like(c, string_table):
+    assert len(c.sql(
+        "SELECT * FROM string_table WHERE a LIKE '%n%'").to_pandas()) == 1
+    assert len(c.sql(
+        r"SELECT * FROM string_table WHERE a LIKE '\%\_\%' ESCAPE '\'").to_pandas()) == 1
+    assert len(c.sql(
+        "SELECT * FROM string_table WHERE a LIKE '%_%'").to_pandas()) == 3
+    assert len(c.sql(
+        "SELECT * FROM string_table WHERE a SIMILAR TO '.*string'").to_pandas()) == 1
+    assert len(c.sql(
+        "SELECT * FROM string_table WHERE a NOT LIKE '%n%'").to_pandas()) == 2
+
+
+def test_coalesce_nullif(c):
+    frame = pd.DataFrame({"a": [1.0, np.nan, 3.0], "b": [np.nan, 2.0, 4.0]})
+    c.create_table("co", frame)
+    result = c.sql(
+        """SELECT COALESCE(a, b) AS c1, COALESCE(a, -1) AS c2,
+                  NULLIF(a, 3) AS n1, GREATEST(a, b) AS g, LEAST(a, b) AS l
+           FROM co""").to_pandas()
+    assert list(result["c1"]) == [1.0, 2.0, 3.0]
+    assert list(result["c2"]) == [1.0, -1.0, 3.0]
+    assert result["n1"][0] == 1.0 and pd.isna(result["n1"][1]) and pd.isna(result["n1"][2])
+
+
+def test_date_extract(c, datetime_table):
+    result = c.sql(
+        """SELECT EXTRACT(YEAR FROM no_timezone) AS y,
+                  EXTRACT(MONTH FROM no_timezone) AS m,
+                  EXTRACT(DAY FROM no_timezone) AS d,
+                  EXTRACT(HOUR FROM no_timezone) AS h,
+                  EXTRACT(MINUTE FROM no_timezone) AS mi,
+                  EXTRACT(DOW FROM no_timezone) AS dow,
+                  EXTRACT(DOY FROM no_timezone) AS doy,
+                  EXTRACT(QUARTER FROM no_timezone) AS q
+           FROM datetime_table""").to_pandas()
+    dt = datetime_table["no_timezone"].dt
+    assert list(result["y"]) == list(dt.year)
+    assert list(result["m"]) == list(dt.month)
+    assert list(result["d"]) == list(dt.day)
+    assert list(result["h"]) == list(dt.hour)
+    assert list(result["mi"]) == list(dt.minute)
+    assert list(result["dow"]) == [(d + 1) % 7 for d in dt.dayofweek]
+    assert list(result["doy"]) == list(dt.dayofyear)
+    assert list(result["q"]) == list(dt.quarter)
+
+
+def test_date_arithmetic(c, datetime_table):
+    result = c.sql(
+        """SELECT no_timezone + INTERVAL '1' DAY AS d1,
+                  no_timezone - INTERVAL '2' HOUR AS d2,
+                  FLOOR(no_timezone TO DAY) AS f,
+                  CEIL(no_timezone TO DAY) AS ce
+           FROM datetime_table""").to_pandas()
+    dt = datetime_table["no_timezone"]
+    assert list(result["d1"]) == list(dt + pd.Timedelta(days=1))
+    assert list(result["d2"]) == list(dt - pd.Timedelta(hours=2))
+    assert list(result["f"]) == list(dt.dt.floor("D"))
+    assert list(result["ce"]) == list(dt.dt.ceil("D"))
+
+
+def test_timestamp_minus(c, datetime_table):
+    result = c.sql(
+        """SELECT no_timezone - TIMESTAMP '2014-08-01 09:00' AS delta
+           FROM datetime_table""").to_pandas()
+    dt = datetime_table["no_timezone"]
+    assert list(result["delta"]) == list(dt - pd.Timestamp("2014-08-01 09:00"))
+
+
+def test_cast(c, df_simple):
+    result = c.sql(
+        """SELECT CAST(a AS DOUBLE) AS d, CAST(b AS INTEGER) AS i,
+                  CAST(a AS VARCHAR) AS s, CAST('42' AS BIGINT) AS p,
+                  CAST(a AS BOOLEAN) AS bo
+           FROM df_simple""").to_pandas()
+    assert list(result["d"]) == [1.0, 2.0, 3.0]
+    assert list(result["i"]) == [1, 2, 3]  # truncation
+    assert list(result["s"]) == ["1", "2", "3"]
+    assert list(result["p"]) == [42, 42, 42]
+    assert list(result["bo"]) == [True, True, True]
+
+
+def test_is_distinct_from(c):
+    frame = pd.DataFrame({"a": [1.0, np.nan, 3.0], "b": [1.0, np.nan, 4.0]})
+    c.create_table("idf", frame)
+    result = c.sql(
+        """SELECT a IS DISTINCT FROM b AS d, a IS NOT DISTINCT FROM b AS nd
+           FROM idf""").to_pandas()
+    assert list(result["d"]) == [False, False, True]
+    assert list(result["nd"]) == [True, True, False]
+
+
+def test_in_list(c, df_simple):
+    result = c.sql("SELECT a IN (1, 3) AS i FROM df_simple").to_pandas()
+    assert list(result["i"]) == [True, False, True]
+
+
+def test_rand(c, df_simple):
+    result = c.sql("SELECT RAND(42) AS r, RAND_INTEGER(1, 10) AS ri FROM df_simple").to_pandas()
+    assert ((result["r"] >= 0) & (result["r"] < 1)).all()
+    assert ((result["ri"] >= 0) & (result["ri"] < 10)).all()
+
+
+def test_between_symmetric(c, df_simple):
+    result = c.sql(
+        "SELECT a BETWEEN SYMMETRIC 3 AND 1 AS b FROM df_simple").to_pandas()
+    assert list(result["b"]) == [True, True, True]

@@ -1,0 +1,44 @@
+"""Schema DDL tests (reference: tests/integration/test_schema.py)."""
+import pandas as pd
+import pytest
+
+from tests.conftest import assert_eq
+
+
+def test_create_and_use_schema(c, df_simple):
+    c.sql("CREATE SCHEMA other")
+    c.sql("USE SCHEMA other")
+    assert c.schema_name == "other"
+    c.create_table("other_table", df_simple)
+    assert_eq(c.sql("SELECT * FROM other_table"), df_simple)
+    # root tables still reachable by qualification
+    assert_eq(c.sql("SELECT * FROM root.df_simple"), df_simple)
+    c.sql("USE SCHEMA root")
+    assert_eq(c.sql("SELECT * FROM other.other_table"), df_simple)
+
+
+def test_drop_schema(c):
+    c.sql("CREATE SCHEMA to_drop")
+    c.sql("DROP SCHEMA to_drop")
+    assert "to_drop" not in c.schema
+    with pytest.raises(RuntimeError):
+        c.sql("DROP SCHEMA to_drop")
+    c.sql("DROP SCHEMA IF EXISTS to_drop")
+
+
+def test_schema_already_exists(c):
+    c.sql("CREATE SCHEMA dup")
+    with pytest.raises(RuntimeError):
+        c.sql("CREATE SCHEMA dup")
+    c.sql("CREATE SCHEMA IF NOT EXISTS dup")
+    c.sql("CREATE OR REPLACE SCHEMA dup")
+
+
+def test_use_unknown_schema(c):
+    with pytest.raises(RuntimeError):
+        c.sql("USE SCHEMA unknown")
+
+
+def test_drop_default_schema_fails(c):
+    with pytest.raises(RuntimeError):
+        c.sql("DROP SCHEMA root")

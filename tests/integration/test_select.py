@@ -1,0 +1,111 @@
+"""SELECT / projection tests (reference: tests/integration/test_select.py)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.conftest import assert_eq
+
+
+def test_select_all(c, df):
+    assert_eq(c.sql("SELECT * FROM df"), df)
+
+
+def test_select_column(c, df):
+    assert_eq(c.sql("SELECT a FROM df"), df[["a"]])
+
+
+def test_select_different_types(c):
+    expected = pd.DataFrame({
+        "date": pd.to_datetime(["2022-01-21 17:34", "2022-01-21", "2021-11-23", None],
+                               format="mixed"),
+        "string": ["this is a test", "another test", "äölüć", ""],
+        "integer": [1, 2, -4, 5],
+        "float": [-1.1, np.nan, 2.3, -4.5],
+    })
+    c.create_table("df2", expected)
+    assert_eq(c.sql("SELECT * FROM df2"), expected)
+
+
+def test_select_expr(c, df):
+    result = c.sql("SELECT a + 1 AS a, b AS bla, a - 1 FROM df").to_pandas()
+    expected = pd.DataFrame({"a": df["a"] + 1, "bla": df["b"], "a - 1": df["a"] - 1})
+    expected.columns = ["a", "bla", "EXPR$2"]
+    assert_eq(result, expected)
+
+
+def test_select_of_select(c, df):
+    result = c.sql(
+        """
+        SELECT 2*c AS e, d - 1 AS f
+        FROM (SELECT a - 1 AS c, 2*b AS d FROM df) AS "inner"
+        """
+    )
+    expected = pd.DataFrame({"e": 2 * (df["a"] - 1), "f": 2 * df["b"] - 1})
+    assert_eq(result, expected)
+
+
+def test_select_of_select_with_casing(c, df):
+    result = c.sql(
+        """
+        SELECT AAA, aaa, aAa
+        FROM (SELECT a - 1 AS aAa, 2*b AS aaa, a + b AS AAA FROM df) AS "inner"
+        """
+    )
+    expected = pd.DataFrame(
+        {"AAA": df["a"] + df["b"], "aaa": 2 * df["b"], "aAa": df["a"] - 1}
+    )
+    assert_eq(result, expected)
+
+
+def test_wrong_input(c):
+    from dask_sql_tpu.utils import ParsingException
+
+    with pytest.raises(ParsingException):
+        c.sql("SELECT x FROM df")
+    with pytest.raises(ParsingException):
+        c.sql("SELECT x FROM unknown_table")
+
+
+def test_timezones(c, datetime_table):
+    result = c.sql("SELECT * FROM datetime_table")
+    expected = datetime_table.copy()
+    # tz-aware columns are normalized to naive UTC on device
+    expected["timezone"] = expected["timezone"].dt.tz_convert("UTC").dt.tz_localize(None)
+    expected["utc_timezone"] = expected["utc_timezone"].dt.tz_localize(None)
+    assert_eq(result, expected)
+
+
+def test_select_from_values(c):
+    result = c.sql("VALUES (1, 'a'), (2, 'b')")
+    expected = pd.DataFrame({"EXPR$0": [1, 2], "EXPR$1": ["a", "b"]})
+    assert_eq(result, expected)
+
+
+def test_literals(c):
+    result = c.sql(
+        """
+        SELECT 'a string äö' AS "S",
+               4.4 AS "F",
+               -4564347464 AS "I",
+               TIME '08:08:00.091' AS "T",
+               TIMESTAMP '2022-04-06 17:33:21' AS "DT",
+               DATE '1991-06-02' AS "D",
+               TRUE AS "B"
+        """
+    ).to_pandas()
+    assert result["S"][0] == "a string äö"
+    assert result["F"][0] == 4.4
+    assert result["I"][0] == -4564347464
+    assert result["DT"][0] == pd.Timestamp("2022-04-06 17:33:21")
+    assert result["D"][0] == pd.Timestamp("1991-06-02")
+    assert bool(result["B"][0]) is True
+
+
+def test_multiple_statements(c, df):
+    result = c.sql("SELECT a FROM df; SELECT b FROM df")
+    assert_eq(result, df[["b"]])
+
+
+def test_null_literal(c):
+    result = c.sql("SELECT NULL AS n, 1 AS o").to_pandas()
+    assert result["n"].isna().all()

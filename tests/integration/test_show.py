@@ -1,0 +1,42 @@
+"""SHOW / DESCRIBE tests (reference: tests/integration/test_show.py)."""
+import pandas as pd
+
+from tests.conftest import assert_eq
+
+
+def test_show_schemas(c):
+    result = c.sql("SHOW SCHEMAS").to_pandas()
+    assert "root" in list(result["Schema"])
+    assert "information_schema" in list(result["Schema"])
+
+
+def test_show_schemas_like(c):
+    result = c.sql("SHOW SCHEMAS LIKE 'root'").to_pandas()
+    assert list(result["Schema"]) == ["root"]
+
+
+def test_show_tables(c):
+    result = c.sql("SHOW TABLES FROM root").to_pandas()
+    assert "df_simple" in list(result["Table"])
+    assert "user_table_1" in list(result["Table"])
+
+
+def test_show_columns(c):
+    result = c.sql("SHOW COLUMNS FROM df_simple").to_pandas()
+    assert list(result["Column"]) == ["a", "b"]
+    assert list(result["Type"]) == ["bigint", "double"]
+
+
+def test_describe(c):
+    result = c.sql("DESCRIBE df_simple").to_pandas()
+    assert list(result["Column"]) == ["a", "b"]
+
+
+def test_analyze(c, df):
+    result = c.sql(
+        "ANALYZE TABLE df COMPUTE STATISTICS FOR ALL COLUMNS").to_pandas()
+    stats = set(result["statistic"])
+    assert "count" in stats and "mean" in stats and "data_type" in stats
+    result2 = c.sql(
+        "ANALYZE TABLE df COMPUTE STATISTICS FOR COLUMNS a").to_pandas()
+    assert "a" in result2.columns and "b" not in result2.columns

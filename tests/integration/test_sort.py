@@ -1,0 +1,69 @@
+"""ORDER BY / LIMIT tests (reference: tests/integration/test_sort.py)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.conftest import assert_eq
+
+
+def test_sort(c, user_table_1):
+    result = c.sql(
+        "SELECT * FROM user_table_1 ORDER BY b, user_id DESC")
+    expected = user_table_1.sort_values(["b", "user_id"], ascending=[True, False])
+    assert_eq(result, expected)
+
+
+def test_sort_desc(c, user_table_1):
+    result = c.sql("SELECT * FROM user_table_1 ORDER BY b DESC")
+    expected = user_table_1.sort_values("b", ascending=False, kind="stable")
+    assert_eq(result, expected)
+
+
+def test_sort_with_nan(c):
+    frame = pd.DataFrame({"a": [1, 2, np.nan], "b": [4, np.nan, 5]})
+    c.create_table("df_nan", frame)
+    result = c.sql("SELECT * FROM df_nan ORDER BY a").to_pandas()
+    # postgres default: NULLS LAST for ASC
+    assert np.isnan(result["a"].iloc[-1])
+    result = c.sql("SELECT * FROM df_nan ORDER BY a DESC").to_pandas()
+    # NULLS FIRST for DESC
+    assert np.isnan(result["a"].iloc[0])
+    result = c.sql("SELECT * FROM df_nan ORDER BY a NULLS FIRST").to_pandas()
+    assert np.isnan(result["a"].iloc[0])
+    result = c.sql("SELECT * FROM df_nan ORDER BY a DESC NULLS LAST").to_pandas()
+    assert np.isnan(result["a"].iloc[-1])
+
+
+def test_sort_strings(c, string_table):
+    result = c.sql("SELECT * FROM string_table ORDER BY a")
+    expected = string_table.sort_values("a")
+    assert_eq(result, expected)
+
+
+def test_limit(c, long_table):
+    assert_eq(c.sql("SELECT * FROM long_table LIMIT 101"), long_table.head(101))
+    assert_eq(c.sql("SELECT * FROM long_table LIMIT 100"), long_table.head(100))
+    assert_eq(
+        c.sql("SELECT * FROM long_table LIMIT 100 OFFSET 99"),
+        long_table.iloc[99 : 99 + 100],
+    )
+    assert_eq(c.sql("SELECT * FROM long_table OFFSET 170"), long_table.iloc[170:])
+
+
+def test_sort_by_expression(c, user_table_1):
+    result = c.sql("SELECT user_id FROM user_table_1 ORDER BY b + user_id, b")
+    expected = user_table_1.assign(k=user_table_1["b"] + user_table_1["user_id"])
+    expected = expected.sort_values(["k", "b"])[["user_id"]]
+    assert_eq(result, expected)
+
+
+def test_sort_by_ordinal(c, user_table_1):
+    result = c.sql("SELECT user_id, b FROM user_table_1 ORDER BY 2, 1")
+    expected = user_table_1.sort_values(["b", "user_id"])[["user_id", "b"]]
+    assert_eq(result, expected)
+
+
+def test_sort_with_limit_expression(c, long_table):
+    result = c.sql("SELECT * FROM long_table ORDER BY a DESC LIMIT 10")
+    expected = long_table.sort_values("a", ascending=False).head(10)
+    assert_eq(result, expected)

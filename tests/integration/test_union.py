@@ -1,0 +1,52 @@
+"""UNION / INTERSECT / EXCEPT tests (reference: tests/integration/test_union.py)."""
+import pandas as pd
+
+from tests.conftest import assert_eq
+
+
+def test_union_all(c, df_simple):
+    result = c.sql("SELECT a FROM df_simple UNION ALL SELECT a FROM df_simple")
+    expected = pd.concat([df_simple[["a"]], df_simple[["a"]]])
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_union_distinct(c, df_simple):
+    result = c.sql("SELECT a FROM df_simple UNION SELECT a FROM df_simple")
+    expected = df_simple[["a"]].drop_duplicates()
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_union_mixed_types(c, df_simple):
+    result = c.sql("SELECT a FROM df_simple UNION ALL SELECT b FROM df_simple")
+    expected = pd.DataFrame({"a": list(df_simple["a"].astype(float)) + list(df_simple["b"])})
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_union_strings(c, string_table):
+    result = c.sql(
+        "SELECT a FROM string_table UNION ALL SELECT UPPER(a) AS a FROM string_table")
+    expected = pd.DataFrame({"a": list(string_table["a"]) +
+                             [s.upper() for s in string_table["a"]]})
+    assert_eq(result, expected, check_row_order=False)
+
+
+def test_intersect(c):
+    c.create_table("i1", pd.DataFrame({"a": [1, 2, 3, 3]}))
+    c.create_table("i2", pd.DataFrame({"a": [2, 3, 4]}))
+    result = c.sql("SELECT a FROM i1 INTERSECT SELECT a FROM i2")
+    assert_eq(result, pd.DataFrame({"a": [2, 3]}), check_row_order=False)
+
+
+def test_except(c):
+    c.create_table("e1", pd.DataFrame({"a": [1, 2, 3, 3]}))
+    c.create_table("e2", pd.DataFrame({"a": [2, 4]}))
+    result = c.sql("SELECT a FROM e1 EXCEPT SELECT a FROM e2")
+    assert_eq(result, pd.DataFrame({"a": [1, 3]}), check_row_order=False)
+
+
+def test_union_with_order_limit(c, df_simple):
+    result = c.sql(
+        """SELECT a FROM df_simple UNION ALL SELECT a FROM df_simple
+           ORDER BY a DESC LIMIT 3""")
+    expected = pd.DataFrame({"a": [3, 3, 2]})
+    assert_eq(result, expected)

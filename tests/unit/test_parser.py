@@ -1,0 +1,86 @@
+"""Parser unit tests (reference: tests/unit/test_utils.py error formatting +
+grammar coverage implied by integration suite)."""
+import pytest
+
+from dask_sql_tpu.sql import ast as A
+from dask_sql_tpu.sql.lexer import tokenize
+from dask_sql_tpu.sql.parser import parse_one, parse_sql
+from dask_sql_tpu.utils import ParsingException
+
+
+def test_tokenize_basic():
+    toks = tokenize("SELECT a, 'str''ing', 1.5e3 FROM \"T\"")
+    kinds = [t.kind for t in toks]
+    assert kinds == ["IDENT", "IDENT", "OP", "STRING", "OP", "NUMBER",
+                     "IDENT", "QIDENT", "EOF"]
+    assert toks[3].text == "str'ing"
+
+
+def test_tokenize_comments():
+    toks = tokenize("SELECT 1 -- comment\n + /* block */ 2")
+    assert [t.text for t in toks if t.kind != "EOF"] == ["SELECT", "1", "+", "2"]
+
+
+def test_parse_select():
+    stmt = parse_one("SELECT a, b AS c FROM t WHERE a > 1")
+    assert isinstance(stmt, A.QueryStatement)
+    q = stmt.query
+    assert len(q.projections) == 2
+    assert q.projections[1][1] == "c"
+    assert q.where is not None
+
+
+def test_parse_error_position():
+    with pytest.raises(ParsingException) as exc:
+        parse_one("SELECT FROM FROM t")
+    assert "^" in str(exc.value)
+
+
+def test_parse_unbalanced():
+    with pytest.raises(ParsingException):
+        parse_one("SELECT (a FROM t")
+
+
+def test_parse_create_model_kwargs():
+    stmt = parse_one(
+        """CREATE MODEL m WITH (
+             model_class = 'sklearn.linear_model.LinearRegression',
+             target_column = 'y', wrap_predict = True, n = 3, f = 1.5,
+             tags = ARRAY ['a', 'b'], nested = (x = 1)
+           ) AS (SELECT 1 AS y)""")
+    assert isinstance(stmt, A.CreateModel)
+    assert stmt.kwargs["model_class"] == "sklearn.linear_model.LinearRegression"
+    assert stmt.kwargs["wrap_predict"] is True
+    assert stmt.kwargs["n"] == 3
+    assert stmt.kwargs["f"] == 1.5
+    assert stmt.kwargs["tags"] == ["a", "b"]
+    assert stmt.kwargs["nested"] == {"x": 1}
+
+
+def test_parse_operator_precedence():
+    stmt = parse_one("SELECT 1 + 2 * 3")
+    expr = stmt.query.projections[0][0]
+    assert expr.op == "+"
+    assert expr.args[1].op == "*"
+
+
+def test_parse_multiple():
+    stmts = parse_sql("SELECT 1; SELECT 2;")
+    assert len(stmts) == 2
+
+
+def test_parse_case_sensitivity():
+    stmt = parse_one("select A, b from T")
+    q = stmt.query
+    assert q.projections[0][0].parts == ["A"]
+    assert q.from_.parts == ["T"]
+
+
+def test_parse_window():
+    stmt = parse_one(
+        "SELECT SUM(x) OVER (PARTITION BY g ORDER BY d DESC ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) FROM t")
+    call = stmt.query.projections[0][0]
+    assert call.over is not None
+    assert len(call.over.partition_by) == 1
+    assert call.over.order_by[0].ascending is False
+    assert call.over.frame == ("ROWS", ("PRECEDING", 2), ("CURRENT", None))

@@ -1,0 +1,66 @@
+"""Columnar Table unit tests (reference: tests/unit/test_datacontainer.py)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu.table import Column, Scalar, Table
+from dask_sql_tpu.types import BIGINT, DOUBLE, VARCHAR, SqlType
+
+
+def test_roundtrip_pandas():
+    df = pd.DataFrame({
+        "i": [1, 2, 3],
+        "f": [1.5, np.nan, 2.5],
+        "s": ["x", None, "zz"],
+        "ni": pd.array([1, None, 3], dtype="Int64"),
+        "d": pd.to_datetime(["2020-01-01", "2020-06-01", None]),
+        "b": [True, False, True],
+    })
+    t = Table.from_pandas(df)
+    out = t.to_pandas()
+    assert list(out["i"]) == [1, 2, 3]
+    assert out["f"][0] == 1.5 and np.isnan(out["f"][1])
+    assert list(out["s"][[0, 2]]) == ["x", "zz"] and pd.isna(out["s"][1])
+    assert out["ni"][0] == 1 and out["ni"][1] is None
+    assert out["d"][0] == pd.Timestamp("2020-01-01")
+    assert pd.isna(out["d"][2])
+
+
+def test_limit_to_and_rename():
+    t = Table.from_pydict({"a": [1, 2], "b": [3, 4]})
+    t2 = t.limit_to(["b"])
+    assert t2.names == ["b"]
+    t3 = t.rename({"a": "x"})
+    assert t3.names == ["x", "b"]
+    # renames are zero-copy: same underlying arrays
+    assert t3.columns[0] is t.columns[0]
+
+
+def test_take_and_slice():
+    t = Table.from_pydict({"a": [1, 2, 3, 4]})
+    assert t.take(np.array([3, 0])).to_pylist() == [[4], [1]]
+    assert t.slice(1, 3).to_pylist() == [[2], [3]]
+
+
+def test_string_dictionary():
+    col = Column.from_numpy(np.array(["b", "a", "b", None], dtype=object))
+    assert col.stype.is_string
+    assert col.null_count() == 1
+    decoded = col.decode()
+    assert list(decoded[:3]) == ["b", "a", "b"] and decoded[3] is None
+    ranks = col.dict_ranks()
+    assert int(ranks.data[0]) > int(ranks.data[1])  # 'b' > 'a'
+
+
+def test_from_scalar():
+    col = Column.from_scalar(Scalar(5, BIGINT), 3)
+    assert col.to_pylist() == [5, 5, 5]
+    null_col = Column.from_scalar(Scalar(None, DOUBLE), 2)
+    assert null_col.null_count() == 2
+
+
+def test_column_types():
+    t = Table.from_pydict({"a": np.array([1, 2], dtype=np.int32)})
+    assert t.columns[0].stype.name == "INTEGER"
+    t = Table.from_pydict({"a": np.array([1.0, 2.0], dtype=np.float32)})
+    assert t.columns[0].stype.name == "FLOAT"

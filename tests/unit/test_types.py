@@ -1,0 +1,54 @@
+"""Type system unit tests (reference: tests/unit/test_mapping.py)."""
+import datetime
+
+import numpy as np
+import pytest
+
+from dask_sql_tpu import types as T
+
+
+def test_numpy_to_sql():
+    assert T.sql_type_from_numpy(np.dtype("int64")).name == "BIGINT"
+    assert T.sql_type_from_numpy(np.dtype("int32")).name == "INTEGER"
+    assert T.sql_type_from_numpy(np.dtype("float64")).name == "DOUBLE"
+    assert T.sql_type_from_numpy(np.dtype("bool")).name == "BOOLEAN"
+    assert T.sql_type_from_numpy(np.dtype("datetime64[ns]")).name == "TIMESTAMP"
+    assert T.sql_type_from_numpy(np.dtype("object")).name == "VARCHAR"
+    assert T.sql_type_from_numpy(np.dtype("uint32")).name == "BIGINT"
+
+
+def test_promote():
+    assert T.promote(T.INTEGER, T.BIGINT).name == "BIGINT"
+    assert T.promote(T.INTEGER, T.DOUBLE).name == "DOUBLE"
+    assert T.promote(T.NULLTYPE, T.VARCHAR).name == "VARCHAR"
+    assert T.promote(T.DATE, T.TIMESTAMP).name == "TIMESTAMP"
+    assert T.promote(T.DATE, T.INTERVAL_DAY_TIME).name == "DATE"
+    with pytest.raises(TypeError):
+        T.promote(T.BOOLEAN, T.DATE)
+
+
+def test_parse_type_name():
+    assert T.parse_type_name("INT").name == "INTEGER"
+    assert T.parse_type_name("STRING").name == "VARCHAR"
+    assert T.parse_type_name("DECIMAL", 10, 2).precision == 10
+    with pytest.raises(NotImplementedError):
+        T.parse_type_name("BLOB")
+
+
+def test_value_conversion_roundtrip():
+    d = datetime.date(2020, 3, 1)
+    phys = T.python_value_to_physical(d, T.DATE)
+    assert T.physical_to_python_value(phys, T.DATE) == d
+
+    ts = datetime.datetime(2021, 7, 1, 12, 30, 45, 123456)
+    phys = T.python_value_to_physical(ts, T.TIMESTAMP)
+    assert T.physical_to_python_value(phys, T.TIMESTAMP) == ts
+
+    td = datetime.timedelta(days=2, hours=3)
+    phys = T.python_value_to_physical(td, T.INTERVAL_DAY_TIME)
+    assert T.physical_to_python_value(phys, T.INTERVAL_DAY_TIME) == td
+
+
+def test_string_date_parsing():
+    assert T.python_value_to_physical("1970-01-02", T.DATE) == 1
+    assert T.python_value_to_physical("1970-01-01 00:00:01", T.TIMESTAMP) == 1_000_000
