@@ -1,0 +1,119 @@
+"""Driver benchmark: groupby+join throughput through the SQL engine on TPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The workload is the BASELINE.md config set: TPC-H Q1 (heavy groupby), Q6 (scan
+filter) and Q3 (join+groupby) over generated TPC-H data, run end-to-end
+through Context.sql on the default JAX platform (the real TPU chip under the
+driver; CPU elsewhere).  ``vs_baseline`` compares against pandas executing the
+same queries on the same host (the reference's single-partition execution
+substrate), as the reference publishes no numbers of its own (BASELINE.md).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+import pandas as pd
+
+
+SF = float(os.environ.get("BENCH_SF", "0.02"))
+REPS = int(os.environ.get("BENCH_REPS", "3"))
+
+
+def _pandas_q1(li: pd.DataFrame) -> float:
+    t0 = time.perf_counter()
+    d = li[li["l_shipdate"] <= pd.Timestamp("1998-09-02")].copy()
+    d["disc_price"] = d["l_extendedprice"] * (1 - d["l_discount"])
+    d["charge"] = d["disc_price"] * (1 + d["l_tax"])
+    d.groupby(["l_returnflag", "l_linestatus"]).agg(
+        sum_qty=("l_quantity", "sum"), sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"), sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"), avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"), count_order=("l_quantity", "count"),
+    ).reset_index().sort_values(["l_returnflag", "l_linestatus"])
+    return time.perf_counter() - t0
+
+
+def _pandas_q6(li: pd.DataFrame) -> float:
+    t0 = time.perf_counter()
+    d = li[(li["l_shipdate"] >= pd.Timestamp("1994-01-01"))
+           & (li["l_shipdate"] < pd.Timestamp("1995-01-01"))
+           & (li["l_discount"].between(0.05, 0.07))
+           & (li["l_quantity"] < 24)]
+    (d["l_extendedprice"] * d["l_discount"]).sum()
+    return time.perf_counter() - t0
+
+
+def _pandas_q3(cu, od, li) -> float:
+    t0 = time.perf_counter()
+    c = cu[cu["c_mktsegment"] == "BUILDING"]
+    o = od[od["o_orderdate"] < pd.Timestamp("1995-03-15")]
+    l = li[li["l_shipdate"] > pd.Timestamp("1995-03-15")]
+    m = c.merge(o, left_on="c_custkey", right_on="o_custkey").merge(
+        l, left_on="o_orderkey", right_on="l_orderkey")
+    m["revenue"] = m["l_extendedprice"] * (1 - m["l_discount"])
+    m.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])["revenue"].sum() \
+        .reset_index().nlargest(10, "revenue")
+    return time.perf_counter() - t0
+
+
+def main():
+    from benchmarks.tpch import QUERIES, generate_tpch
+    from dask_sql_tpu import Context
+
+    data = generate_tpch(SF)
+    n_lineitem = len(data["lineitem"])
+
+    c = Context()
+    for name, frame in data.items():
+        c.create_table(name, frame)
+
+    queries = {1: QUERIES[1], 6: QUERIES[6], 3: QUERIES[3]}
+
+    # warmup (compilation) then measure
+    for q in queries.values():
+        c.sql(q)
+    times = {}
+    for qid, q in queries.items():
+        best = float("inf")
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            result = c.sql(q)
+            for col in result.columns:
+                np.asarray(col.data)  # block on device work
+            best = min(best, time.perf_counter() - t0)
+        times[qid] = best
+
+    # pandas baseline (single-threaded host — the reference's per-partition
+    # execution substrate)
+    li, cu, od = data["lineitem"], data["customer"], data["orders"]
+    p_times = {1: min(_pandas_q1(li) for _ in range(REPS)),
+               6: min(_pandas_q6(li) for _ in range(REPS)),
+               3: min(_pandas_q3(cu, od, li) for _ in range(REPS))}
+
+    total = sum(times.values())
+    rows_processed = 3 * n_lineitem  # each query scans lineitem once
+    throughput = rows_processed / total
+    pandas_total = sum(p_times.values())
+    vs_baseline = pandas_total / total  # >1 = faster than baseline
+
+    print(json.dumps({
+        "metric": "tpch_q1_q3_q6_groupby_join_throughput",
+        "value": round(throughput, 1),
+        "unit": "rows/sec/chip",
+        "vs_baseline": round(vs_baseline, 3),
+        "detail": {
+            "sf": SF, "lineitem_rows": n_lineitem,
+            "engine_sec": {str(k): round(v, 4) for k, v in times.items()},
+            "pandas_sec": {str(k): round(v, 4) for k, v in p_times.items()},
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,270 @@
+"""TPC-H data generator (dbgen-shaped, numpy) and query texts.
+
+Generates the 8 TPC-H tables with dbgen's schema, key relationships and
+cardinalities (scale-factor relative), with value distributions shaped like
+dbgen's — for throughput benchmarking of the engine, not for validating
+official answer sets.  Correctness is covered by the sqlite differential
+oracle in tests/ (the reference's strategy: semantics from oracles, SURVEY §6).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+_REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+_NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
+]
+_SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+_PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+_SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+_INSTRUCTS = ["DELIVER IN PERSON", "COLLECT COD", "NONE", "TAKE BACK RETURN"]
+_TYPES = [f"{a} {b} {c}" for a in ("STANDARD", "SMALL", "MEDIUM", "LARGE",
+                                   "ECONOMY", "PROMO")
+          for b in ("ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED")
+          for c in ("TIN", "NICKEL", "BRASS", "STEEL", "COPPER")]
+_CONTAINERS = [f"{a} {b}" for a in ("SM", "LG", "MED", "JUMBO", "WRAP")
+               for b in ("CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM")]
+
+_D = lambda s: (pd.Timestamp(s) - pd.Timestamp("1970-01-01")).days  # noqa: E731
+
+
+def generate_tpch(sf: float = 0.01, seed: int = 0) -> dict:
+    """Returns {table_name: pandas.DataFrame} for the 8 TPC-H tables."""
+    rng = np.random.RandomState(seed)
+    n_part = max(int(200_000 * sf), 50)
+    n_supp = max(int(10_000 * sf), 10)
+    n_cust = max(int(150_000 * sf), 30)
+    n_ord = max(int(1_500_000 * sf), 150)
+    n_nation = len(_NATIONS)
+
+    region = pd.DataFrame({
+        "r_regionkey": np.arange(5), "r_name": _REGIONS,
+        "r_comment": ["" for _ in range(5)],
+    })
+    nation = pd.DataFrame({
+        "n_nationkey": np.arange(n_nation),
+        "n_name": [n for n, _ in _NATIONS],
+        "n_regionkey": [r for _, r in _NATIONS],
+        "n_comment": ["" for _ in range(n_nation)],
+    })
+    supplier = pd.DataFrame({
+        "s_suppkey": np.arange(1, n_supp + 1),
+        "s_name": [f"Supplier#{i:09d}" for i in range(1, n_supp + 1)],
+        "s_address": [f"addr{i}" for i in range(n_supp)],
+        "s_nationkey": rng.randint(0, n_nation, n_supp),
+        "s_phone": [f"{i:010d}" for i in range(n_supp)],
+        "s_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_supp), 2),
+        "s_comment": ["" for _ in range(n_supp)],
+    })
+    part = pd.DataFrame({
+        "p_partkey": np.arange(1, n_part + 1),
+        "p_name": rng.choice(["ivory blue", "green navy", "red linen",
+                              "metallic olive", "antique puff"], n_part),
+        "p_mfgr": [f"Manufacturer#{i % 5 + 1}" for i in range(n_part)],
+        "p_brand": [f"Brand#{i % 5 + 1}{i % 5 + 1}" for i in range(n_part)],
+        "p_type": rng.choice(_TYPES, n_part),
+        "p_size": rng.randint(1, 51, n_part),
+        "p_container": rng.choice(_CONTAINERS, n_part),
+        "p_retailprice": np.round(900 + (np.arange(1, n_part + 1) % 1000) / 10.0
+                                  + 100 * (np.arange(1, n_part + 1) % 10), 2),
+        "p_comment": ["" for _ in range(n_part)],
+    })
+    n_ps = n_part * 4
+    partsupp = pd.DataFrame({
+        "ps_partkey": np.repeat(np.arange(1, n_part + 1), 4),
+        "ps_suppkey": rng.randint(1, n_supp + 1, n_ps),
+        "ps_availqty": rng.randint(1, 10_000, n_ps),
+        "ps_supplycost": np.round(rng.uniform(1.0, 1000.0, n_ps), 2),
+        "ps_comment": ["" for _ in range(n_ps)],
+    })
+    customer = pd.DataFrame({
+        "c_custkey": np.arange(1, n_cust + 1),
+        "c_name": [f"Customer#{i:09d}" for i in range(1, n_cust + 1)],
+        "c_address": [f"addr{i}" for i in range(n_cust)],
+        "c_nationkey": rng.randint(0, n_nation, n_cust),
+        "c_phone": [f"{i:010d}" for i in range(n_cust)],
+        "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_cust), 2),
+        "c_mktsegment": rng.choice(_SEGMENTS, n_cust),
+        "c_comment": ["" for _ in range(n_cust)],
+    })
+    o_dates = rng.randint(_D("1992-01-01"), _D("1998-08-02"), n_ord)
+    orders = pd.DataFrame({
+        "o_orderkey": np.arange(1, n_ord + 1) * 4,  # dbgen sparse keys
+        "o_custkey": rng.randint(1, n_cust + 1, n_ord),
+        "o_orderstatus": rng.choice(["F", "O", "P"], n_ord, p=[0.49, 0.49, 0.02]),
+        "o_totalprice": np.round(rng.uniform(800.0, 600_000.0, n_ord), 2),
+        "o_orderdate": pd.to_datetime(o_dates, unit="D"),
+        "o_orderpriority": rng.choice(_PRIORITIES, n_ord),
+        "o_clerk": [f"Clerk#{i % 1000:09d}" for i in range(n_ord)],
+        "o_shippriority": np.zeros(n_ord, dtype=np.int64),
+        "o_comment": ["" for _ in range(n_ord)],
+    })
+    lines_per_order = rng.randint(1, 8, n_ord)
+    n_li = int(lines_per_order.sum())
+    li_order = np.repeat(orders["o_orderkey"].to_numpy(), lines_per_order)
+    li_odate = np.repeat(o_dates, lines_per_order)
+    ship_delay = rng.randint(1, 122, n_li)
+    ship = li_odate + ship_delay
+    commit = li_odate + rng.randint(30, 91, n_li)
+    receipt = ship + rng.randint(1, 31, n_li)
+    returnflag = np.where(receipt <= _D("1995-06-17"),
+                          rng.choice(["R", "A"], n_li), "N")
+    lineitem = pd.DataFrame({
+        "l_orderkey": li_order,
+        "l_partkey": rng.randint(1, n_part + 1, n_li),
+        "l_suppkey": rng.randint(1, n_supp + 1, n_li),
+        "l_linenumber": np.concatenate([np.arange(1, k + 1) for k in lines_per_order]),
+        "l_quantity": rng.randint(1, 51, n_li).astype(np.float64),
+        "l_extendedprice": np.round(rng.uniform(900.0, 105_000.0, n_li), 2),
+        "l_discount": np.round(rng.randint(0, 11, n_li) / 100.0, 2),
+        "l_tax": np.round(rng.randint(0, 9, n_li) / 100.0, 2),
+        "l_returnflag": returnflag,
+        "l_linestatus": np.where(ship > _D("1995-06-17"), "O", "F"),
+        "l_shipdate": pd.to_datetime(ship, unit="D"),
+        "l_commitdate": pd.to_datetime(commit, unit="D"),
+        "l_receiptdate": pd.to_datetime(receipt, unit="D"),
+        "l_shipinstruct": rng.choice(_INSTRUCTS, n_li),
+        "l_shipmode": rng.choice(_SHIPMODES, n_li),
+        "l_comment": ["" for _ in range(n_li)],
+    })
+    return {
+        "region": region, "nation": nation, "supplier": supplier,
+        "part": part, "partsupp": partsupp, "customer": customer,
+        "orders": orders, "lineitem": lineitem,
+    }
+
+
+QUERIES = {
+    1: """
+        SELECT l_returnflag, l_linestatus,
+               SUM(l_quantity) AS sum_qty,
+               SUM(l_extendedprice) AS sum_base_price,
+               SUM(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+               SUM(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+               AVG(l_quantity) AS avg_qty,
+               AVG(l_extendedprice) AS avg_price,
+               AVG(l_discount) AS avg_disc,
+               COUNT(*) AS count_order
+        FROM lineitem
+        WHERE l_shipdate <= DATE '1998-09-02'
+        GROUP BY l_returnflag, l_linestatus
+        ORDER BY l_returnflag, l_linestatus
+    """,
+    3: """
+        SELECT l_orderkey,
+               SUM(l_extendedprice * (1 - l_discount)) AS revenue,
+               o_orderdate, o_shippriority
+        FROM customer, orders, lineitem
+        WHERE c_mktsegment = 'BUILDING'
+          AND c_custkey = o_custkey
+          AND l_orderkey = o_orderkey
+          AND o_orderdate < DATE '1995-03-15'
+          AND l_shipdate > DATE '1995-03-15'
+        GROUP BY l_orderkey, o_orderdate, o_shippriority
+        ORDER BY revenue DESC, o_orderdate
+        LIMIT 10
+    """,
+    5: """
+        SELECT n_name,
+               SUM(l_extendedprice * (1 - l_discount)) AS revenue
+        FROM customer, orders, lineitem, supplier, nation, region
+        WHERE c_custkey = o_custkey
+          AND l_orderkey = o_orderkey
+          AND l_suppkey = s_suppkey
+          AND c_nationkey = s_nationkey
+          AND s_nationkey = n_nationkey
+          AND n_regionkey = r_regionkey
+          AND r_name = 'ASIA'
+          AND o_orderdate >= DATE '1994-01-01'
+          AND o_orderdate < DATE '1995-01-01'
+        GROUP BY n_name
+        ORDER BY revenue DESC
+    """,
+    6: """
+        SELECT SUM(l_extendedprice * l_discount) AS revenue
+        FROM lineitem
+        WHERE l_shipdate >= DATE '1994-01-01'
+          AND l_shipdate < DATE '1995-01-01'
+          AND l_discount BETWEEN 0.05 AND 0.07
+          AND l_quantity < 24
+    """,
+    9: """
+        SELECT nation, o_year, SUM(amount) AS sum_profit
+        FROM (
+            SELECT n_name AS nation,
+                   EXTRACT(YEAR FROM o_orderdate) AS o_year,
+                   l_extendedprice * (1 - l_discount)
+                     - ps_supplycost * l_quantity AS amount
+            FROM part, supplier, lineitem, partsupp, orders, nation
+            WHERE s_suppkey = l_suppkey
+              AND ps_suppkey = l_suppkey
+              AND ps_partkey = l_partkey
+              AND p_partkey = l_partkey
+              AND o_orderkey = l_orderkey
+              AND s_nationkey = n_nationkey
+              AND p_name LIKE '%green%'
+        ) AS profit
+        GROUP BY nation, o_year
+        ORDER BY nation, o_year DESC
+    """,
+    10: """
+        SELECT c_custkey, c_name,
+               SUM(l_extendedprice * (1 - l_discount)) AS revenue,
+               c_acctbal, n_name, c_address, c_phone, c_comment
+        FROM customer, orders, lineitem, nation
+        WHERE c_custkey = o_custkey
+          AND l_orderkey = o_orderkey
+          AND o_orderdate >= DATE '1993-10-01'
+          AND o_orderdate < DATE '1994-01-01'
+          AND l_returnflag = 'R'
+          AND c_nationkey = n_nationkey
+        GROUP BY c_custkey, c_name, c_acctbal, c_phone, n_name, c_address, c_comment
+        ORDER BY revenue DESC
+        LIMIT 20
+    """,
+    12: """
+        SELECT l_shipmode,
+               SUM(CASE WHEN o_orderpriority = '1-URGENT'
+                         OR o_orderpriority = '2-HIGH' THEN 1 ELSE 0 END) AS high_line_count,
+               SUM(CASE WHEN o_orderpriority <> '1-URGENT'
+                        AND o_orderpriority <> '2-HIGH' THEN 1 ELSE 0 END) AS low_line_count
+        FROM orders, lineitem
+        WHERE o_orderkey = l_orderkey
+          AND l_shipmode IN ('MAIL', 'SHIP')
+          AND l_commitdate < l_receiptdate
+          AND l_shipdate < l_commitdate
+          AND l_receiptdate >= DATE '1994-01-01'
+          AND l_receiptdate < DATE '1995-01-01'
+        GROUP BY l_shipmode
+        ORDER BY l_shipmode
+    """,
+    14: """
+        SELECT 100.00 * SUM(CASE WHEN p_type LIKE 'PROMO%'
+                                 THEN l_extendedprice * (1 - l_discount)
+                                 ELSE 0 END) / SUM(l_extendedprice * (1 - l_discount)) AS promo_revenue
+        FROM lineitem, part
+        WHERE l_partkey = p_partkey
+          AND l_shipdate >= DATE '1995-09-01'
+          AND l_shipdate < DATE '1995-10-01'
+    """,
+    18: """
+        SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+               SUM(l_quantity) AS total_qty
+        FROM customer, orders, lineitem
+        WHERE o_orderkey IN (
+                SELECT l_orderkey FROM lineitem
+                GROUP BY l_orderkey HAVING SUM(l_quantity) > 300)
+          AND c_custkey = o_custkey
+          AND o_orderkey = l_orderkey
+        GROUP BY c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+        ORDER BY o_totalprice DESC, o_orderdate
+        LIMIT 100
+    """,
+}

@@ -144,7 +144,7 @@ def push_filters(rel: RelNode) -> RelNode:
     # -- into Join sides
     if isinstance(child, LogicalJoin) and child.join_type in ("INNER", "LEFT", "RIGHT", "CROSS"):
         nl = len(child.left.schema)
-        left_side, right_side, stay = [], [], []
+        left_side, right_side, into_join, stay = [], [], [], []
         for c in conjuncts:
             refs = rex_inputs(c)
             if not _is_pure(c):
@@ -153,9 +153,14 @@ def push_filters(rel: RelNode) -> RelNode:
                 left_side.append(c)
             elif all(r >= nl for r in refs) and child.join_type in ("INNER", "RIGHT", "CROSS"):
                 right_side.append(c)
+            elif child.join_type in ("INNER", "CROSS"):
+                # both-side conjunct becomes part of the join condition so the
+                # executor can extract equi keys (FILTER_INTO_JOIN,
+                # RelationalAlgebraGenerator.java:207-208)
+                into_join.append(c)
             else:
                 stay.append(c)
-        if left_side or right_side:
+        if left_side or right_side or into_join:
             new_left, new_right = child.left, child.right
             if left_side:
                 new_left = push_filters(LogicalFilter(
@@ -167,9 +172,16 @@ def push_filters(rel: RelNode) -> RelNode:
                 new_right = push_filters(LogicalFilter(
                     input=child.right, condition=_and_all(shifted),
                     schema=child.right.schema))
+            cond = child.condition
+            jt = child.join_type
+            if into_join:
+                pieces = ([] if cond is None or (
+                    isinstance(cond, RexLiteral) and cond.value is True) else [cond])
+                cond = _and_all(pieces + into_join)
+                jt = "INNER"
             new_join = LogicalJoin(left=new_left, right=new_right,
-                                   join_type=child.join_type,
-                                   condition=child.condition, schema=child.schema)
+                                   join_type=jt, condition=cond,
+                                   schema=child.schema)
             if stay:
                 return LogicalFilter(input=new_join, condition=_and_all(stay),
                                      schema=rel.schema)

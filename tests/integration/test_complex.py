@@ -1,0 +1,109 @@
+"""A big multi-clause query (reference: tests/integration/test_complex.py)."""
+import numpy as np
+import pandas as pd
+
+
+def test_complex_query(c):
+    rng = np.random.RandomState(42)
+    n = 500
+    frame = pd.DataFrame({
+        "user_id": rng.randint(0, 20, n),
+        "category": rng.choice(["a", "b", "c", "d"], n),
+        "amount": np.round(rng.uniform(0, 100, n), 2),
+        "ts": pd.to_datetime(
+            rng.randint(1577836800, 1609459200, n), unit="s"),
+    })
+    c.create_table("events", frame)
+
+    result = c.sql(
+        """
+        WITH spend AS (
+            SELECT user_id, category, SUM(amount) AS total,
+                   COUNT(*) AS n_events
+            FROM events
+            WHERE EXTRACT(YEAR FROM ts) = 2020
+            GROUP BY user_id, category
+        )
+        SELECT s.category,
+               COUNT(*) AS n_users,
+               SUM(s.total) AS category_total,
+               AVG(s.total) AS avg_user_total,
+               MAX(s.n_events) AS max_events
+        FROM spend s
+        WHERE s.total > (SELECT AVG(total) * 0.5 FROM spend)
+        GROUP BY s.category
+        HAVING COUNT(*) > 1
+        ORDER BY category_total DESC
+        """).to_pandas()
+
+    # pandas cross-check
+    f = frame[frame["ts"].dt.year == 2020]
+    spend = f.groupby(["user_id", "category"]).agg(
+        total=("amount", "sum"), n_events=("amount", "count")).reset_index()
+    spend = spend[spend["total"] > spend["total"].mean() * 0.5]
+    exp = spend.groupby("category").agg(
+        n_users=("total", "count"), category_total=("total", "sum"),
+        avg_user_total=("total", "mean"), max_events=("n_events", "max"),
+    ).reset_index()
+    exp = exp[exp["n_users"] > 1].sort_values("category_total", ascending=False)
+
+    np.testing.assert_array_equal(result["category"].values, exp["category"].values)
+    np.testing.assert_allclose(result["category_total"].values,
+                               exp["category_total"].values, rtol=1e-9)
+    np.testing.assert_allclose(result["avg_user_total"].values,
+                               exp["avg_user_total"].values, rtol=1e-9)
+
+
+def test_tpch_q1_small(c):
+    from benchmarks.tpch import QUERIES, generate_tpch
+
+    data = generate_tpch(0.001)
+    for name, frame in data.items():
+        c.create_table(name, frame)
+    result = c.sql(QUERIES[1]).to_pandas()
+
+    li = data["lineitem"]
+    d = li[li["l_shipdate"] <= pd.Timestamp("1998-09-02")].copy()
+    d["disc_price"] = d["l_extendedprice"] * (1 - d["l_discount"])
+    d["charge"] = d["disc_price"] * (1 + d["l_tax"])
+    exp = d.groupby(["l_returnflag", "l_linestatus"]).agg(
+        sum_qty=("l_quantity", "sum"), sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"), sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"), avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"), count_order=("l_quantity", "size"),
+    ).reset_index().sort_values(["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+
+    assert list(result["l_returnflag"]) == list(exp["l_returnflag"])
+    np.testing.assert_allclose(result["sum_disc_price"], exp["sum_disc_price"], rtol=1e-9)
+    np.testing.assert_allclose(result["avg_disc"], exp["avg_disc"], rtol=1e-9)
+    np.testing.assert_array_equal(result["count_order"], exp["count_order"])
+
+
+def test_tpch_q3_q6_small(c):
+    from benchmarks.tpch import QUERIES, generate_tpch
+
+    data = generate_tpch(0.001)
+    for name, frame in data.items():
+        c.create_table(name, frame)
+
+    r6 = c.sql(QUERIES[6]).to_pandas()
+    li = data["lineitem"]
+    d = li[(li["l_shipdate"] >= pd.Timestamp("1994-01-01"))
+           & (li["l_shipdate"] < pd.Timestamp("1995-01-01"))
+           & (li["l_discount"] >= 0.05) & (li["l_discount"] <= 0.07)
+           & (li["l_quantity"] < 24)]
+    expected6 = (d["l_extendedprice"] * d["l_discount"]).sum()
+    np.testing.assert_allclose(r6.iloc[0, 0], expected6, rtol=1e-9)
+
+    r3 = c.sql(QUERIES[3]).to_pandas()
+    cu, od = data["customer"], data["orders"]
+    m = (cu[cu["c_mktsegment"] == "BUILDING"]
+         .merge(od[od["o_orderdate"] < pd.Timestamp("1995-03-15")],
+                left_on="c_custkey", right_on="o_custkey")
+         .merge(li[li["l_shipdate"] > pd.Timestamp("1995-03-15")],
+                left_on="o_orderkey", right_on="l_orderkey"))
+    m["revenue"] = m["l_extendedprice"] * (1 - m["l_discount"])
+    exp3 = (m.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])["revenue"]
+            .sum().reset_index().sort_values(["revenue", "o_orderdate"],
+                                             ascending=[False, True]).head(10))
+    np.testing.assert_allclose(sorted(r3["revenue"]), sorted(exp3["revenue"]), rtol=1e-9)

@@ -1,0 +1,123 @@
+"""Multi-device kernel tests on the virtual 8-device CPU mesh (SURVEY §4:
+same suite, mesh via env switch)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dask_sql_tpu.parallel import distributed as D
+from dask_sql_tpu.parallel.mesh import default_mesh, row_sharding, shard_table
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    m = default_mesh()
+    if m.devices.size < 2:
+        pytest.skip("needs a multi-device mesh")
+    return m
+
+
+def _shard(mesh, x):
+    return jax.device_put(jnp.asarray(x), row_sharding(mesh))
+
+
+def test_dist_segment_sum(mesh):
+    n = 64
+    codes = np.random.RandomState(0).randint(0, 10, n)
+    vals = np.random.RandomState(1).rand(n)
+    out = D.dist_segment_sum(mesh, _shard(mesh, vals), _shard(mesh, codes), 10)
+    ref = jax.ops.segment_sum(jnp.asarray(vals), jnp.asarray(codes), 10)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-12)
+
+
+def test_hash_exchange_preserves_rows(mesh):
+    n = 64
+    codes = np.random.RandomState(0).randint(0, 13, n).astype(np.int64)
+    vals = np.arange(n, dtype=np.float64)
+    new_codes, new_vals = D.hash_exchange(mesh, _shard(mesh, codes), _shard(mesh, vals))
+    nc = np.asarray(new_codes)
+    nv = np.asarray(new_vals)
+    kept = nc >= 0
+    # every row arrives exactly once
+    assert kept.sum() == n
+    assert sorted(nv[kept]) == sorted(vals)
+    # rows with equal key land on the same device shard
+    per_dev = nc.reshape(mesh.devices.size, -1)
+    owner = {}
+    for d in range(mesh.devices.size):
+        for code in per_dev[d][per_dev[d] >= 0]:
+            assert owner.setdefault(int(code), d) == d
+
+
+def test_dist_groupby_sum_exchange(mesh):
+    n = 128
+    codes = np.random.RandomState(3).randint(0, 20, n).astype(np.int64)
+    vals = np.random.RandomState(4).rand(n)
+    out = D.dist_groupby_sum_exchange(mesh, _shard(mesh, codes), _shard(mesh, vals), 20)
+    ref = jax.ops.segment_sum(jnp.asarray(vals), jnp.asarray(codes), 20)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-12)
+
+
+def test_dist_prefix_sum(mesh):
+    n = 64
+    vals = np.random.RandomState(5).rand(n)
+    out = D.dist_prefix_sum(mesh, _shard(mesh, vals))
+    np.testing.assert_allclose(np.asarray(out), np.cumsum(vals), rtol=1e-12)
+
+
+def test_dist_join_broadcast(mesh):
+    n = 64
+    build_codes = (np.arange(n) % 8).astype(np.int64)
+    build_vals = np.arange(n, dtype=np.float64)
+    # make build keys unique: keep first occurrence semantics via unique codes
+    build_codes = np.arange(n, dtype=np.int64)
+    probe = np.random.RandomState(6).randint(0, 2 * n, n).astype(np.int64)
+    got = D.dist_join_broadcast(mesh, _shard(mesh, probe),
+                                _shard(mesh, build_codes), _shard(mesh, build_vals),
+                                -1.0)
+    exp = np.where(probe < n, probe.astype(np.float64), -1.0)
+    np.testing.assert_allclose(np.asarray(got), exp)
+
+
+def test_ring_shift(mesh):
+    k = mesh.devices.size
+    x = np.arange(k * 4, dtype=np.float64)
+    out = np.asarray(D.ring_shift(mesh, _shard(mesh, x), 1))
+    shifted = np.roll(x.reshape(k, 4), 1, axis=0).reshape(-1)
+    np.testing.assert_allclose(out, shifted)
+
+
+def test_shard_table_roundtrip(mesh):
+    import pandas as pd
+    from dask_sql_tpu.table import Table
+
+    df = pd.DataFrame({"a": np.arange(10), "s": list("abcabcabca")})
+    t = Table.from_pandas(df)
+    st, n = shard_table(t, mesh)
+    assert n == 10
+    assert st.num_rows % mesh.devices.size == 0
+    # padded rows are masked invalid
+    assert st.columns[0].valid_mask().sum() == 10
+
+
+def test_engine_on_sharded_input(mesh, c):
+    """End-to-end: eager kernels run transparently on sharded arrays
+    (computation follows data; XLA inserts collectives)."""
+    import pandas as pd
+    from dask_sql_tpu.table import Table
+
+    n = 80
+    df = pd.DataFrame({
+        "g": np.random.RandomState(0).randint(0, 5, n),
+        "v": np.random.RandomState(1).rand(n),
+    })
+    t = Table.from_pandas(df)
+    st, _ = shard_table(t, mesh)
+    c.create_table("sharded_t", st)
+    result = c.sql(
+        "SELECT g, SUM(v) AS s, COUNT(*) AS n FROM sharded_t GROUP BY g ORDER BY g"
+    ).to_pandas()
+    exp = df.groupby("g")["v"].agg(["sum", "count"]).reset_index()
+    np.testing.assert_allclose(result["s"], exp["sum"], rtol=1e-9)
+    np.testing.assert_array_equal(result["n"], exp["count"])

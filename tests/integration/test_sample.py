@@ -1,0 +1,25 @@
+"""TABLESAMPLE tests (reference: tests/integration/test_sample.py)."""
+import pytest
+
+
+def test_sample_bernoulli(c, df):
+    result = c.sql(
+        "SELECT * FROM df TABLESAMPLE BERNOULLI (30) REPEATABLE (42)").to_pandas()
+    # statistically ~30% of 700 rows; generous bounds like the reference
+    assert 100 < len(result) < 350
+    # repeatable: same seed -> same rows
+    result2 = c.sql(
+        "SELECT * FROM df TABLESAMPLE BERNOULLI (30) REPEATABLE (42)").to_pandas()
+    assert len(result) == len(result2)
+
+
+def test_sample_system(c, df):
+    result = c.sql(
+        "SELECT * FROM df TABLESAMPLE SYSTEM (50) REPEATABLE (7)").to_pandas()
+    assert 0 <= len(result) <= len(df)
+
+
+def test_sample_full(c, df):
+    result = c.sql(
+        "SELECT * FROM df TABLESAMPLE BERNOULLI (100) REPEATABLE (1)").to_pandas()
+    assert len(result) == len(df)
