@@ -1,0 +1,42 @@
+"""Packaging for dask_sql_tpu (reference: /root/reference/setup.py console
+scripts at :106-111; no jar build step — the planner is native Python/C++)."""
+import os
+
+from setuptools import Extension, find_packages, setup
+
+ext_modules = []
+# the native lexer builds opportunistically; pure-python fallback otherwise
+if os.environ.get("DASK_SQL_TPU_BUILD_NATIVE", "1") == "1":
+    ext_modules.append(
+        Extension(
+            "dask_sql_tpu.native._lexer",
+            sources=["native/lexer.cpp"],
+            extra_compile_args=["-O2", "-std=c++17"],
+            optional=True,
+        )
+    )
+
+setup(
+    name="dask_sql_tpu",
+    version="0.1.0",
+    description="TPU-native distributed SQL query engine (dask-sql capability parity)",
+    packages=find_packages(include=["dask_sql_tpu", "dask_sql_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=[
+        "jax",
+        "numpy",
+        "pandas",
+    ],
+    extras_require={
+        "dev": ["pytest"],
+        "ml": ["scikit-learn", "joblib"],
+        "cli": ["prompt_toolkit", "pygments"],
+    },
+    entry_points={
+        "console_scripts": [
+            "dask-sql-tpu = dask_sql_tpu.cmd:main",
+            "dask-sql-tpu-server = dask_sql_tpu.server.app:main",
+        ]
+    },
+    ext_modules=ext_modules,
+)

@@ -1,0 +1,152 @@
+"""SQL-driven ML tests (reference: tests/integration/test_model.py)."""
+import os
+import pickle
+import tempfile
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+@pytest.fixture()
+def training_df(c):
+    rng = np.random.RandomState(42)
+    n = 200
+    df = pd.DataFrame({
+        "x": rng.uniform(-5, 5, n),
+        "y": rng.uniform(-5, 5, n),
+    })
+    df["target"] = (df["x"] * 2 + df["y"] > 0).astype(np.int64)
+    c.create_table("timeseries", df)
+    return df
+
+
+def test_create_model(c, training_df):
+    c.sql("""
+        CREATE MODEL my_model WITH (
+            model_class = 'sklearn.linear_model.LogisticRegression',
+            target_column = 'target'
+        ) AS (SELECT x, y, target FROM timeseries)
+    """)
+    assert "my_model" in c.schema[c.schema_name].models
+    model, columns = c.schema[c.schema_name].models["my_model"]
+    assert columns == ["x", "y"]
+    assert hasattr(model, "predict")
+
+
+def test_predict(c, training_df):
+    c.sql("""
+        CREATE MODEL my_model WITH (
+            model_class = 'sklearn.linear_model.LogisticRegression',
+            target_column = 'target'
+        ) AS (SELECT x, y, target FROM timeseries)
+    """)
+    result = c.sql("""
+        SELECT * FROM PREDICT(MODEL my_model, SELECT x, y FROM timeseries)
+    """).to_pandas()
+    assert "target" in result.columns
+    assert len(result) == len(training_df)
+    # sanity: mostly matches the trained labels (separable data)
+    acc = (result["target"] == training_df["target"]).mean()
+    assert acc > 0.9
+
+
+def test_show_and_describe_models(c, training_df):
+    c.sql("""
+        CREATE MODEL my_model WITH (
+            model_class = 'sklearn.linear_model.LogisticRegression',
+            target_column = 'target'
+        ) AS (SELECT x, y, target FROM timeseries)
+    """)
+    models = c.sql("SHOW MODELS").to_pandas()
+    assert "my_model" in list(models["Models"])
+    desc = c.sql("DESCRIBE MODEL my_model").to_pandas()
+    assert "training_columns" in list(desc["Params"])
+
+
+def test_drop_model(c, training_df):
+    with pytest.raises(RuntimeError):
+        c.sql("DROP MODEL no_model")
+    c.sql("DROP MODEL IF EXISTS no_model")
+    c.sql("""
+        CREATE MODEL my_model WITH (
+            model_class = 'sklearn.linear_model.LogisticRegression',
+            target_column = 'target'
+        ) AS (SELECT x, y, target FROM timeseries)
+    """)
+    c.sql("DROP MODEL my_model")
+    assert "my_model" not in c.schema[c.schema_name].models
+
+
+def test_replace_and_if_not_exists(c, training_df):
+    q = """
+        CREATE MODEL my_model WITH (
+            model_class = 'sklearn.linear_model.LogisticRegression',
+            target_column = 'target'
+        ) AS (SELECT x, y, target FROM timeseries)
+    """
+    c.sql(q)
+    with pytest.raises(RuntimeError):
+        c.sql(q)
+    c.sql(q.replace("CREATE MODEL", "CREATE MODEL IF NOT EXISTS"))
+    c.sql(q.replace("CREATE MODEL", "CREATE OR REPLACE MODEL"))
+
+
+def test_create_experiment(c, training_df):
+    result = c.sql("""
+        CREATE EXPERIMENT exp WITH (
+            model_class = 'sklearn.linear_model.LogisticRegression',
+            experiment_class = 'sklearn.model_selection.GridSearchCV',
+            tune_parameters = (C = ARRAY [0.1, 1.0]),
+            target_column = 'target'
+        ) AS (SELECT x, y, target FROM timeseries)
+    """)
+    assert "exp" in c.schema[c.schema_name].models
+    assert result is not None
+    df = result.to_pandas()
+    assert "mean_test_score" in df.columns
+
+
+def test_export_model(c, training_df):
+    c.sql("""
+        CREATE MODEL my_model WITH (
+            model_class = 'sklearn.linear_model.LogisticRegression',
+            target_column = 'target'
+        ) AS (SELECT x, y, target FROM timeseries)
+    """)
+    with tempfile.TemporaryDirectory() as d:
+        pkl = os.path.join(d, "model.pkl")
+        c.sql(f"EXPORT MODEL my_model WITH (format = 'pickle', location = '{pkl}')")
+        with open(pkl, "rb") as f:
+            model = pickle.load(f)
+        assert hasattr(model, "predict")
+
+        jbl = os.path.join(d, "model.joblib")
+        c.sql(f"EXPORT MODEL my_model WITH (format = 'joblib', location = '{jbl}')")
+        import joblib
+        assert hasattr(joblib.load(jbl), "predict")
+
+    with pytest.raises(NotImplementedError):
+        c.sql("EXPORT MODEL my_model WITH (format = 'onnx', location = 'x.onnx')")
+
+
+def test_ml_experiment_requires_class(c, training_df):
+    with pytest.raises(AttributeError):
+        c.sql("""
+            CREATE EXPERIMENT failing WITH (target_column = 'target')
+            AS (SELECT x, y, target FROM timeseries)
+        """)
+
+
+def test_predict_on_expression_query(c, training_df):
+    c.sql("""
+        CREATE MODEL my_model WITH (
+            model_class = 'sklearn.linear_model.LinearRegression',
+            target_column = 'target'
+        ) AS (SELECT x, y, target FROM timeseries)
+    """)
+    result = c.sql("""
+        SELECT AVG(target) AS avg_pred
+        FROM PREDICT(MODEL my_model, SELECT x, y FROM timeseries)
+    """).to_pandas()
+    assert 0.0 <= result["avg_pred"][0] <= 1.0

@@ -1,0 +1,82 @@
+"""Presto-protocol server tests (reference: tests/integration/test_server.py —
+route codes, async polling loop, cancellation, error shape)."""
+import json
+import time
+import urllib.request
+
+import pandas as pd
+import pytest
+
+
+@pytest.fixture(scope="module")
+def server():
+    from dask_sql_tpu.context import Context
+    from dask_sql_tpu.server.app import run_server
+
+    context = Context()
+    context.create_table("df", pd.DataFrame({"a": [1, 2, 3], "b": list("xyz")}))
+    srv = run_server(context=context, host="127.0.0.1", port=18745, blocking=False)
+    yield "http://127.0.0.1:18745"
+    srv.shutdown()
+
+
+def _post(url, body):
+    req = urllib.request.Request(url, data=body.encode(), method="POST")
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read())
+
+
+def _run_to_completion(server, sql, timeout=30):
+    payload = _post(f"{server}/v1/statement", sql)
+    deadline = time.time() + timeout
+    while "nextUri" in payload and time.time() < deadline:
+        time.sleep(0.05)
+        payload = _get(payload["nextUri"])
+    return payload
+
+
+def test_empty(server):
+    payload = _get(f"{server}/v1/empty")
+    assert payload["columns"] == [] and payload["data"] == []
+
+
+def test_query(server):
+    payload = _run_to_completion(server, "SELECT * FROM df ORDER BY a")
+    assert [c["name"] for c in payload["columns"]] == ["a", "b"]
+    assert [c["type"] for c in payload["columns"]] == ["bigint", "varchar"]
+    assert payload["data"] == [[1, "x"], [2, "y"], [3, "z"]]
+    assert payload["stats"]["state"] == "FINISHED"
+
+
+def test_error_shape(server):
+    payload = _run_to_completion(server, "SELECT * FROM missing_table")
+    assert "error" in payload
+    assert payload["error"]["errorName"] == "GENERIC_ERROR"
+    assert "errorLocation" in payload["error"]
+
+
+def test_unknown_id(server):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(f"{server}/v1/status/nope")
+    assert exc.value.code == 404
+
+
+def test_cancel(server):
+    payload = _post(f"{server}/v1/statement", "SELECT 1 + 1")
+    cancel = payload["partialCancelUri"]
+    req = urllib.request.Request(cancel, method="DELETE")
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 200
+    # the id is gone afterwards
+    with pytest.raises(urllib.error.HTTPError):
+        _get(payload["nextUri"])
+
+
+def test_aggregate_via_server(server):
+    payload = _run_to_completion(server, "SELECT SUM(a) AS s FROM df")
+    assert payload["data"] == [[6]]
