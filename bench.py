@@ -23,6 +23,38 @@ import pandas as pd
 
 SF = float(os.environ.get("BENCH_SF", "0.02"))
 REPS = int(os.environ.get("BENCH_REPS", "3"))
+PLATFORM_PROBE_TIMEOUT = float(os.environ.get("BENCH_PLATFORM_TIMEOUT", "180"))
+
+
+def _ensure_usable_platform():
+    """Pin JAX to a platform that actually initializes.
+
+    The default platform may be a tunneled TPU whose backend init can hang
+    indefinitely if the tunnel is down; probing in a subprocess with a timeout
+    guarantees bench.py always emits its JSON line.  ``BENCH_PLATFORM``
+    overrides the probe entirely.
+    """
+    import subprocess
+
+    forced = os.environ.get("BENCH_PLATFORM")
+    import jax
+
+    if forced:
+        jax.config.update("jax_platforms", forced)
+        return forced
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=PLATFORM_PROBE_TIMEOUT, capture_output=True)
+        if probe.returncode == 0:
+            return None  # default platform is healthy
+        sys.stderr.write(probe.stderr.decode(errors="replace")[-2000:])
+    except subprocess.TimeoutExpired:
+        pass
+    print("bench: default JAX platform unusable; falling back to CPU",
+          file=sys.stderr)
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu"
 
 
 def _pandas_q1(li: pd.DataFrame) -> float:
@@ -63,6 +95,7 @@ def _pandas_q3(cu, od, li) -> float:
 
 
 def main():
+    _ensure_usable_platform()
     from benchmarks.tpch import QUERIES, generate_tpch
     from dask_sql_tpu import Context
 

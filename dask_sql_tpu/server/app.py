@@ -196,8 +196,10 @@ def run_server(context=None, host: str = "0.0.0.0", port: int = 8080,
         context.sql("SELECT 1 + 1")
 
     state = _AppState(context)
-    base_url = f"http://{host}:{port}"
-    server = ThreadingHTTPServer((host, port), _make_handler(state, base_url))
+    # bind first so port=0 (ephemeral) yields correct nextUri links
+    server = ThreadingHTTPServer((host, port), _make_handler(state, ""))
+    base_url = f"http://{host}:{server.server_port}"
+    server.RequestHandlerClass = _make_handler(state, base_url)
     server.app_state = state
     context.server = server
     if not blocking:

@@ -1,20 +1,6 @@
 """Packaging for dask_sql_tpu (reference: /root/reference/setup.py console
 scripts at :106-111; no jar build step — the planner is native Python/C++)."""
-import os
-
-from setuptools import Extension, find_packages, setup
-
-ext_modules = []
-# the native lexer builds opportunistically; pure-python fallback otherwise
-if os.environ.get("DASK_SQL_TPU_BUILD_NATIVE", "1") == "1":
-    ext_modules.append(
-        Extension(
-            "dask_sql_tpu.native._lexer",
-            sources=["native/lexer.cpp"],
-            extra_compile_args=["-O2", "-std=c++17"],
-            optional=True,
-        )
-    )
+from setuptools import find_packages, setup
 
 setup(
     name="dask_sql_tpu",
@@ -38,5 +24,4 @@ setup(
             "dask-sql-tpu-server = dask_sql_tpu.server.app:main",
         ]
     },
-    ext_modules=ext_modules,
 )

@@ -7,6 +7,8 @@ import numpy as np
 import pandas as pd
 import pytest
 
+sklearn = pytest.importorskip("sklearn")
+
 
 @pytest.fixture()
 def training_df(c):
@@ -121,9 +123,9 @@ def test_export_model(c, training_df):
             model = pickle.load(f)
         assert hasattr(model, "predict")
 
+        joblib = pytest.importorskip("joblib")
         jbl = os.path.join(d, "model.joblib")
         c.sql(f"EXPORT MODEL my_model WITH (format = 'joblib', location = '{jbl}')")
-        import joblib
         assert hasattr(joblib.load(jbl), "predict")
 
     with pytest.raises(NotImplementedError):

@@ -2,6 +2,7 @@
 route codes, async polling loop, cancellation, error shape)."""
 import json
 import time
+import urllib.error
 import urllib.request
 
 import pandas as pd
@@ -15,8 +16,8 @@ def server():
 
     context = Context()
     context.create_table("df", pd.DataFrame({"a": [1, 2, 3], "b": list("xyz")}))
-    srv = run_server(context=context, host="127.0.0.1", port=18745, blocking=False)
-    yield "http://127.0.0.1:18745"
+    srv = run_server(context=context, host="127.0.0.1", port=0, blocking=False)
+    yield f"http://127.0.0.1:{srv.server_port}"
     srv.shutdown()
 
 
