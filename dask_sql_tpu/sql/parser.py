@@ -39,6 +39,7 @@ RESERVED = {
     "ASC", "DESC", "NULLS", "FIRST", "LAST", "CAST", "INTERVAL", "CREATE",
     "DROP", "SHOW", "DESCRIBE", "ANALYZE", "WITH", "VALUES", "OVER",
     "PARTITION", "TABLESAMPLE", "FETCH", "FILTER", "THEN", "TO", "FOR",
+    "NATURAL",  # else the table-alias rule swallows it before join parsing
 }
 
 _COMPARISONS = {"=", "<>", "!=", "<", "<=", ">", ">="}
@@ -1129,6 +1130,22 @@ def _number_value(text: str):
 
 
 def parse_sql(sql: str) -> List[Statement]:
+    """Parse SQL text into AST statements.
+
+    Prefers the native C++ parser (native/parser.cpp via ctypes — the
+    counterpart of the reference's native Java planner front-end,
+    RelationalAlgebraGenerator.java:87); the pure-Python parser below is the
+    fallback when the library is unavailable (``DSQL_NATIVE=0`` disables the
+    native path explicitly).
+    """
+    from .. import native as _native
+    from . import native_bridge
+
+    envelope = _native.parse_to_json(sql)
+    if envelope is not None:
+        stmts = native_bridge.json_to_statements(envelope, sql)
+        if stmts is not None:
+            return stmts
     return Parser(sql).parse_statements()
 
 

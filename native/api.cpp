@@ -1,0 +1,78 @@
+// C API for the native SQL planner front-end (loaded from Python via ctypes —
+// pybind11 is not available in this environment; the reference exposes its
+// native planner to Python through an in-process bridge the same way, via
+// JPype: /root/reference/dask_sql/java.py:62-98).
+//
+// Contract:
+//   dsql_parse(sql) -> malloc'd UTF-8 JSON string, either
+//     {"ok": <statement array>}  or
+//     {"error": {"msg": ..., "line": N, "col": N, "width": N}}
+//   The caller must release the result with dsql_free().
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "lexer.h"
+#include "parser.h"
+
+namespace {
+
+std::string jescape(const std::string& s) {
+  std::string out = "\"";
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += (char)c;
+        }
+    }
+  }
+  out += '"';
+  return out;
+}
+
+char* dup_string(const std::string& s) {
+  char* out = (char*)std::malloc(s.size() + 1);
+  if (out) std::memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+std::string error_json(const std::string& msg, int line, int col, int width) {
+  return "{\"error\":{\"msg\":" + jescape(msg) + ",\"line\":" + std::to_string(line) +
+         ",\"col\":" + std::to_string(col) + ",\"width\":" + std::to_string(width) +
+         "}}";
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* dsql_version() { return "1"; }
+
+char* dsql_parse(const char* sql) {
+  try {
+    std::string result = dsql::parse_statements_json(sql ? sql : "");
+    return dup_string("{\"ok\":" + result + "}");
+  } catch (const dsql::ParseError& e) {
+    return dup_string(error_json(e.msg, e.line, e.col, e.width));
+  } catch (const dsql::LexError& e) {
+    return dup_string(error_json(e.msg, e.line, e.col, 1));
+  } catch (const std::exception& e) {
+    return dup_string(error_json(std::string("internal: ") + e.what(), 1, 1, 1));
+  } catch (...) {
+    return dup_string(error_json("internal: unknown error", 1, 1, 1));
+  }
+}
+
+void dsql_free(char* p) { std::free(p); }
+
+}  // extern "C"
