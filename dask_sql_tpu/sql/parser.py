@@ -59,7 +59,9 @@ class Parser:
     # ------------------------------------------------------------------ utils
     @property
     def cur(self) -> Token:
-        return self.tokens[self.i]
+        # clamped: the lexer always appends an EOF token, so running past the
+        # end keeps returning it instead of raising IndexError
+        return self.tokens[min(self.i, len(self.tokens) - 1)]
 
     def peek(self, k: int = 0) -> Token:
         j = min(self.i + k, len(self.tokens) - 1)
@@ -301,6 +303,8 @@ class Parser:
         if kind == "SCHEMAS":
             like = None
             if self.eat_kw("LIKE"):
+                if self.cur.kind != "STRING":
+                    self.error("Expected a string literal after LIKE")
                 like = self.cur.text
                 self.i += 1
             return ShowSchemas(like=like, pos=pos)
@@ -371,25 +375,33 @@ class Parser:
                     break
         body = self._parse_set_expr()
         order_by, limit, offset = self._parse_order_limit()
-        if isinstance(body, Select) and not body.order_by:
+        # A "raw" body (VALUES, or a parenthesized/nested-WITH query that
+        # already owns its ORDER BY/LIMIT) is opaque: outer clauses must wrap
+        # it in a Select over a subquery, never merge into it (they would
+        # apply twice).  Mirror of the native parser's parse_query_parts,
+        # where these bodies are kind=RAW.
+        raw = not isinstance(body, (Select, SetOp)) or \
+            getattr(body, "_raw_body", False)
+        if not raw and isinstance(body, Select) and not body.order_by:
             body.ctes = ctes + body.ctes
             body.order_by = order_by
             body.limit = limit if body.limit is None else body.limit
             body.offset = offset if body.offset is None else body.offset
             return body
-        if isinstance(body, SetOp):
+        outer = bool(order_by) or limit is not None or offset is not None
+        needs_wrap = bool(ctes) or (raw and outer)
+        if isinstance(body, SetOp) and not raw and not needs_wrap:
             body.order_by = order_by
             body.limit = limit
             body.offset = offset
-        if ctes:
-            # wrap in a Select to carry CTEs
-            if not isinstance(body, Select):
-                sel = Select(projections=[(Star(), None)],
-                             from_=SubqueryRelation(query=body, alias="__cte_body__"))
-                sel.ctes = ctes
-                sel.order_by = order_by
-                sel.limit, sel.offset = limit, offset
-                return sel
+        if needs_wrap:
+            # wrap in a Select to carry CTEs and/or outer ORDER BY/LIMIT
+            sel = Select(projections=[(Star(), None)],
+                         from_=SubqueryRelation(query=body, alias="__cte_body__"))
+            sel.ctes = ctes
+            sel.order_by = order_by
+            sel.limit, sel.offset = limit, offset
+            return sel
         return body
 
     def _parse_order_limit(self):
@@ -446,6 +458,9 @@ class Parser:
             self.expect_op("(")
             q = self.parse_query()
             self.expect_op(")")
+            # a parenthesized query is opaque ("raw"): outer ORDER BY/LIMIT
+            # must wrap it, never merge into it (native parser kind=RAW)
+            q._raw_body = True
             return q
         pos = (self.cur.line, self.cur.col)
         if self.at_kw("VALUES"):
@@ -462,7 +477,9 @@ class Parser:
                     break
             return ValuesQuery(rows=rows, pos=pos)
         if self.at_kw("WITH"):
-            return self.parse_query()
+            q = self.parse_query()
+            q._raw_body = True
+            return q
         self.expect_kw("SELECT")
         distinct = False
         if self.eat_kw("DISTINCT"):
@@ -804,13 +821,18 @@ class Parser:
         prec = scale = None
         if self.at_op("("):
             self.i += 1
-            prec = int(self.cur.text)
-            self.i += 1
+            prec = self._type_param()
             if self.eat_op(","):
-                scale = int(self.cur.text)
-                self.i += 1
+                scale = self._type_param()
             self.expect_op(")")
         return name, prec, scale
+
+    def _type_param(self) -> int:
+        if self.cur.kind != "NUMBER" or not self.cur.text.isdigit():
+            self.error("Expected an integer type parameter")
+        value = int(self.cur.text)
+        self.i += 1
+        return value
 
     def _parse_primary(self) -> Expr:
         t = self.cur

@@ -17,28 +17,7 @@
 
 namespace {
 
-std::string jescape(const std::string& s) {
-  std::string out = "\"";
-  for (unsigned char c : s) {
-    switch (c) {
-      case '"': out += "\\\""; break;
-      case '\\': out += "\\\\"; break;
-      case '\n': out += "\\n"; break;
-      case '\r': out += "\\r"; break;
-      case '\t': out += "\\t"; break;
-      default:
-        if (c < 0x20) {
-          char buf[8];
-          snprintf(buf, sizeof buf, "\\u%04x", c);
-          out += buf;
-        } else {
-          out += (char)c;
-        }
-    }
-  }
-  out += '"';
-  return out;
-}
+using dsql::json_quote;
 
 char* dup_string(const std::string& s) {
   char* out = (char*)std::malloc(s.size() + 1);
@@ -47,7 +26,7 @@ char* dup_string(const std::string& s) {
 }
 
 std::string error_json(const std::string& msg, int line, int col, int width) {
-  return "{\"error\":{\"msg\":" + jescape(msg) + ",\"line\":" + std::to_string(line) +
+  return "{\"error\":{\"msg\":" + json_quote(msg) + ",\"line\":" + std::to_string(line) +
          ",\"col\":" + std::to_string(col) + ",\"width\":" + std::to_string(width) +
          "}}";
 }

@@ -1,6 +1,7 @@
 #include "parser.h"
 
 #include <cctype>
+#include <cmath>
 #include <initializer_list>
 #include <set>
 #include <sstream>
@@ -10,6 +11,29 @@
 #include "lexer.h"
 
 namespace dsql {
+
+std::string json_quote(const std::string& s) {
+  std::string out = "\"";
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += (char)c;
+        }
+    }
+  }
+  out += '"';
+  return out;
+}
 
 namespace {
 
@@ -32,28 +56,7 @@ const std::set<std::string> kJoinTypes = {"INNER", "LEFT", "RIGHT", "FULL", "CRO
 
 // ----------------------------------------------------------------- JSON utils
 
-std::string jstr(const std::string& s) {
-  std::string out = "\"";
-  for (unsigned char c : s) {
-    switch (c) {
-      case '"': out += "\\\""; break;
-      case '\\': out += "\\\\"; break;
-      case '\n': out += "\\n"; break;
-      case '\r': out += "\\r"; break;
-      case '\t': out += "\\t"; break;
-      default:
-        if (c < 0x20) {
-          char buf[8];
-          snprintf(buf, sizeof buf, "\\u%04x", c);
-          out += buf;
-        } else {
-          out += (char)c;
-        }
-    }
-  }
-  out += '"';
-  return out;
-}
+std::string jstr(const std::string& s) { return json_quote(s); }
 
 // Emit a SQL NUMBER token verbatim as a JSON number.  json.loads applies the
 // same int-vs-float rule as the Python parser's _number_value ('.'/'e' =>
@@ -116,7 +119,11 @@ class Parser {
   size_t i_ = 0;
 
   // --------------------------------------------------------------- helpers
-  const Token& cur() const { return tokens_[i_]; }
+  // Clamped like peek(): tokenize() always appends an END token, so running
+  // past the end must keep returning it, never read out of bounds.
+  const Token& cur() const {
+    return tokens_[i_ < tokens_.size() ? i_ : tokens_.size() - 1];
+  }
   const Token& peek(size_t k = 0) const {
     size_t j = i_ + k;
     if (j >= tokens_.size()) j = tokens_.size() - 1;
@@ -381,6 +388,8 @@ class Parser {
     if (kind == "SCHEMAS") {
       std::string like = "null";
       if (!eat_kw({"LIKE"}).empty()) {
+        if (cur().kind != Tk::STRING)
+          error("Expected a string literal after LIKE");
         like = jstr(cur().text);
         ++i_;
       }
@@ -512,13 +521,18 @@ class Parser {
       if (body.offset == "null") body.offset = offset;
       return body;
     }
-    if (body.kind == SelectParts::SETOP) {
+    bool raw_needs_wrap =
+        body.kind == SelectParts::RAW &&
+        (order_by != "[]" || limit != "null" || offset != "null");
+    bool needs_wrap =
+        (!ctes.empty() && body.kind != SelectParts::SELECT) || raw_needs_wrap;
+    if (body.kind == SelectParts::SETOP && !needs_wrap) {
       body.order_by = order_by;
       body.limit = limit;
       body.offset = offset;
     }
-    if (!ctes.empty() && body.kind != SelectParts::SELECT) {
-      // wrap in a Select to carry the CTEs
+    if (needs_wrap) {
+      // wrap in a Select to carry the CTEs and/or outer ORDER BY/LIMIT
       SelectParts sel;
       sel.kind = SelectParts::SELECT;
       sel.projections = R"([[{"t":"Star","table":null,"pos":[0,0]},null]])";
@@ -579,26 +593,9 @@ class Parser {
            (asc ? "true" : "false") + ",\"nulls_first\":" + nulls_first + "}";
   }
 
-  SelectParts parse_set_expr() {
-    SelectParts left = parse_select_core();
-    for (;;) {
-      std::string pos = pos_here();
-      std::string op = eat_kw({"UNION", "INTERSECT", "EXCEPT", "MINUS"});
-      if (op.empty()) return left;
-      if (op == "MINUS") op = "EXCEPT";
-      bool all = !eat_kw({"ALL"}).empty();
-      if (!all) eat_kw({"DISTINCT"});
-      SelectParts right = parse_select_core();
-      std::string lj = finish_parts(left), rj = finish_parts(right);
-      SelectParts so;
-      so.raw_prefix = R"({"t":"SetOp","op":)" + jstr(op) + ",\"all\":" +
-                      (all ? "true" : "false") + ",\"left\":" + lj +
-                      ",\"right\":" + rj + ",\"pos\":" + pos;
-      return parse_set_tail(so);
-    }
-  }
+  SelectParts parse_set_expr() { return parse_set_tail(parse_select_core()); }
 
-  // chain further set ops onto an existing SetOp prefix
+  // chain set ops onto a parsed left-hand side (no-op if none follow)
   SelectParts parse_set_tail(SelectParts left) {
     for (;;) {
       std::string pos = pos_here();
@@ -610,19 +607,12 @@ class Parser {
       SelectParts right = parse_select_core();
       std::string lj = finish_parts(left), rj = finish_parts(right);
       SelectParts so;
+      so.kind = SelectParts::SETOP;
       so.raw_prefix = R"({"t":"SetOp","op":)" + jstr(op) + ",\"all\":" +
                       (all ? "true" : "false") + ",\"left\":" + lj +
                       ",\"right\":" + rj + ",\"pos\":" + pos;
       left = std::move(so);
     }
-  }
-
-  // Serialize a SelectParts as a complete JSON node (no outer ORDER/LIMIT).
-  std::string finish_parts(const SelectParts& p) {
-    if (p.is_select)
-      return select_json(p, p.ctes, p.order_by, p.limit, p.offset);
-    if (!p.raw.empty()) return p.raw;
-    return p.raw_prefix + ",\"order_by\":[],\"limit\":null,\"offset\":null}";
   }
 
   SelectParts parse_select_core() {
@@ -680,7 +670,7 @@ class Parser {
       }
       if (!eat_op(",")) break;
     }
-    out.is_select = true;
+    out.kind = SelectParts::SELECT;
     out.projections = jarr(projections);
     out.distinct = distinct ? "true" : "false";
     out.pos = pos;
@@ -1071,14 +1061,19 @@ class Parser {
     scale = "null";
     if (at_op({"("})) {
       ++i_;
-      prec = cur().text;
-      ++i_;
-      if (eat_op(",")) {
-        scale = cur().text;
-        ++i_;
-      }
+      prec = type_param();
+      if (eat_op(",")) scale = type_param();
       expect_op(")");
     }
+  }
+
+  std::string type_param() {
+    if (cur().kind != Tk::NUMBER ||
+        cur().text.find_first_not_of("0123456789") != std::string::npos)
+      error("Expected an integer type parameter");
+    std::string v = cur().text;
+    ++i_;
+    return v;
   }
 
   std::string parse_primary() {
@@ -1451,13 +1446,19 @@ class Parser {
       } else {
         double dv = std::strtod(s, &end);
         if (end && *end == '\0' && end != s) {
-          std::ostringstream os;
-          os.precision(17);
-          os << dv;
-          value = os.str();
-          if (value.find('.') == std::string::npos &&
-              value.find('e') == std::string::npos)
-            value += ".0";
+          if (std::isnan(dv)) {
+            value = "NaN";  // Python's json.loads accepts NaN/Infinity
+          } else if (std::isinf(dv)) {
+            value = dv > 0 ? "Infinity" : "-Infinity";
+          } else {
+            std::ostringstream os;
+            os.precision(17);
+            os << dv;
+            value = os.str();
+            if (value.find('.') == std::string::npos &&
+                value.find('e') == std::string::npos)
+              value += ".0";
+          }
           numeric = true;
         } else {
           value = jstr(raw_text);

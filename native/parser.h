@@ -22,4 +22,7 @@ struct ParseError {
 // statement nodes. Throws ParseError or LexError.
 std::string parse_statements_json(const std::string& sql);
 
+// JSON-escape a string, including the surrounding quotes.
+std::string json_quote(const std::string& s);
+
 }  // namespace dsql

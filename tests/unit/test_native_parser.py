@@ -130,11 +130,16 @@ CORPUS = [
     # multiple statements
     "SELECT 1; SELECT 2;",
     "CREATE SCHEMA s1; USE SCHEMA s1; SELECT 1",
+    # outer ORDER BY/LIMIT over raw bodies (must wrap, not merge/drop)
+    "VALUES (1), (2), (3) LIMIT 2",
+    "VALUES (1), (2), (3) ORDER BY 1 DESC LIMIT 1 OFFSET 1",
+    "(SELECT a FROM t) ORDER BY a",
+    "(SELECT a FROM t ORDER BY a LIMIT 5) LIMIT 2",
+    "(SELECT a FROM t UNION SELECT b FROM s ORDER BY 1) LIMIT 2",
+    "WITH c AS (SELECT a FROM t) SELECT a FROM c UNION ALL SELECT 9"
+    " ORDER BY 1 LIMIT 3 OFFSET 1",
+    "SELECT a FROM t UNION SELECT b FROM s ORDER BY 1 LIMIT 3",
 ]
-
-
-def _strip_orig(stmts):
-    return stmts  # original_name is compared explicitly in test_original_name
 
 
 @pytest.mark.parametrize("sql", CORPUS, ids=range(len(CORPUS)))
@@ -165,7 +170,20 @@ ERROR_CORPUS = [
     "SELECT a FROM t GROUP",
     "FROB THE KNOB",
     "SELECT a b c, FROM t",
+    # truncated statements must error cleanly, not read past the END token
+    "SHOW SCHEMAS LIKE",
+    "SELECT CAST(a AS DECIMAL(",
+    "SELECT a FROM t ORDER BY",
+    "SELECT INTERVAL",
 ]
+
+
+def test_interval_nonfinite_value():
+    """Overflowing interval strings survive the JSON round trip (inf/nan)."""
+    for sql in ("SELECT INTERVAL '1e400' DAY", "SELECT INTERVAL '-1e400' DAY"):
+        n = native_bridge.json_to_statements(native.parse_to_json(sql), sql)
+        p = Parser(sql).parse_statements()
+        assert n == p
 
 
 @pytest.mark.parametrize("sql", ERROR_CORPUS, ids=range(len(ERROR_CORPUS)))
