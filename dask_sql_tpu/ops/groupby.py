@@ -66,28 +66,28 @@ def segment_aggregate(op: str, col: Optional[Column], codes: Optional[jax.Array]
         s = jax.ops.segment_sum(work, codes, num_groups)
         if op == "SUM":
             return Column(s.astype(physical_dtype(out_type)), out_type,
-                          None if bool(has_any.all()) else has_any)
+                          has_any)
         if op == "$SUM0":
             return Column(s.astype(physical_dtype(out_type)), out_type, None)
         mean = s.astype(jnp.float64) / jnp.maximum(count, 1)
         if op == "AVG":
-            return Column(mean, out_type, None if bool(has_any.all()) else has_any)
+            return Column(mean, out_type, has_any)
         sq = jnp.where(valid, data.astype(jnp.float64) ** 2, 0.0)
         s2 = jax.ops.segment_sum(sq, codes, num_groups)
         var_pop = s2 / jnp.maximum(count, 1) - mean**2
         var_pop = jnp.maximum(var_pop, 0.0)
         if op == "VAR_POP":
-            return Column(var_pop, out_type, None if bool(has_any.all()) else has_any)
+            return Column(var_pop, out_type, has_any)
         denom = jnp.maximum(count - 1, 1)
         var_samp = (s2 - count * mean**2) / denom
         var_samp = jnp.maximum(var_samp, 0.0)
         ok = count > 1
         if op in ("VAR_SAMP", "VARIANCE"):
-            return Column(var_samp, out_type, None if bool(ok.all()) else ok)
+            return Column(var_samp, out_type, ok)
         if op == "STDDEV_POP":
             return Column(jnp.sqrt(var_pop), out_type,
-                          None if bool(has_any.all()) else has_any)
-        return Column(jnp.sqrt(var_samp), out_type, None if bool(ok.all()) else ok)
+                          has_any)
+        return Column(jnp.sqrt(var_samp), out_type, ok)
 
     if op in ("MIN", "MAX"):
         if col.stype.is_string:
@@ -103,7 +103,7 @@ def segment_aggregate(op: str, col: Optional[Column], codes: Optional[jax.Array]
             safe = jnp.clip(out_ranks, 0, len(order) - 1)
             out_codes = jnp.take(inv, safe).astype(jnp.int32)
             return Column(out_codes, out_type,
-                          None if bool(has_any.all()) else has_any, col.dictionary)
+                          has_any, col.dictionary)
         if jnp.issubdtype(data.dtype, jnp.floating):
             sentinel = jnp.inf if op == "MIN" else -jnp.inf
         elif data.dtype == jnp.bool_:
@@ -116,16 +116,16 @@ def segment_aggregate(op: str, col: Optional[Column], codes: Optional[jax.Array]
         f = jax.ops.segment_min if op == "MIN" else jax.ops.segment_max
         out = f(work, codes, num_groups)
         out = out.astype(physical_dtype(out_type))
-        return Column(out, out_type, None if bool(has_any.all()) else has_any)
+        return Column(out, out_type, has_any)
 
     if op in ("EVERY", "BOOL_AND"):
         work = jnp.where(valid, data.astype(bool), True)
         out = jax.ops.segment_min(work.astype(jnp.int32), codes, num_groups) > 0
-        return Column(out, out_type, None if bool(has_any.all()) else has_any)
+        return Column(out, out_type, has_any)
     if op in ("BOOL_OR", "ANY"):
         work = jnp.where(valid, data.astype(bool), False)
         out = jax.ops.segment_max(work.astype(jnp.int32), codes, num_groups) > 0
-        return Column(out, out_type, None if bool(has_any.all()) else has_any)
+        return Column(out, out_type, has_any)
 
     if op in ("ANY_VALUE", "SINGLE_VALUE", "FIRST_VALUE", "LAST_VALUE"):
         n = codes.shape[0]
@@ -138,7 +138,7 @@ def segment_aggregate(op: str, col: Optional[Column], codes: Optional[jax.Array]
             pick = jax.ops.segment_min(work, codes, num_groups)
         safe = jnp.clip(pick, 0, max(n - 1, 0))
         out = col.take(safe)
-        return out.with_mask((out.valid_mask() & has_any) if out.mask is not None or not bool(has_any.all()) else None)
+        return out.with_mask(out.valid_mask() & has_any)
 
     if op in ("BIT_AND", "BIT_OR", "BIT_XOR"):
         # no XLA segment primitive for bit ops: host reduceat over sorted codes

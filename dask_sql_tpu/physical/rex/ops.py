@@ -310,7 +310,7 @@ def logical_and(args, stype, ctx):
     out = va & vb
     # known if: both known, or either is a known False
     known = (ka & kb) | (ka & ~va) | (kb & ~vb)
-    mask = None if bool(known.all()) else known
+    mask = known
     return Column(out, BOOLEAN, mask)
 
 
@@ -327,7 +327,7 @@ def logical_or(args, stype, ctx):
     vb, kb = _to_bool_parts(args[1], n)
     out = va | vb
     known = (ka & kb) | (ka & va) | (kb & vb)
-    mask = None if bool(known.all()) else known
+    mask = known
     return Column(out, BOOLEAN, mask)
 
 
@@ -425,7 +425,7 @@ def case_op(args: List[Value], stype: SqlType, ctx) -> Value:
         out_data = jnp.where(sel, val.data, out_data)
         out_valid = jnp.where(sel, val.valid_mask(), out_valid)
         taken = taken | sel
-    mask = None if bool(out_valid.all()) else out_valid
+    mask = out_valid
     dictionary = else_c.dictionary
     if stype.is_string:
         # string CASE: fall back to host path for dictionary merge
@@ -473,7 +473,7 @@ def coalesce_op(args: List[Value], stype: SqlType, ctx) -> Value:
     for c in cols[1:]:
         out = jnp.where(valid, out, c.data)
         valid = valid | c.valid_mask()
-    return Column(out, stype, None if bool(valid.all()) else valid)
+    return Column(out, stype, valid)
 
 
 def nullif_op(args, stype, ctx):
