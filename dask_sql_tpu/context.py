@@ -179,6 +179,12 @@ class Context:
 
         if isinstance(stmt, A.QueryStatement):
             plan = self._get_plan(stmt.query, sql)
+            # whole-plan jit (one device dispatch per query); falls back to
+            # the eager per-op executor for plan shapes outside its subset
+            from .physical.compiled import try_execute_compiled
+            result = try_execute_compiled(plan, self)
+            if result is not None:
+                return result
             return RelExecutor(self).execute(plan)
         handler = StatementDispatcher.get_plugin(type(stmt).__name__)
         return handler(stmt, self, sql)

@@ -1,0 +1,738 @@
+"""Compiled query pipelines: whole-plan jit with static shapes.
+
+The eager executor (physical/rel/executor.py) dispatches one XLA op at a
+time; over a remote TPU every dispatch is a host round trip and every
+data-dependent shape (boolean compaction, ``jnp.unique``) is a blocking sync.
+This module is the TPU-first answer (SURVEY §7 "hard parts" item 2): a query
+plan is traced ONCE into a single jitted program with *static shapes* —
+filters keep rows and flip a validity mask instead of compacting, GROUP BY
+factorizes via an in-trace lexsort with a static group-capacity bound, and
+equi-joins probe a sorted build side via ``searchsorted`` — then the program
+is cached keyed by (plan fingerprint, input table identity/shape). Steady
+state is ONE device dispatch + one tiny flags transfer per query.
+
+Runtime conditions XLA cannot express statically (group-count overflow,
+non-unique build side, 64-bit hash collision) surface through a flags vector;
+the host reacts by recompiling with a larger capacity or falling back to the
+eager executor. Unsupported plan shapes (UDFs, scalar subqueries, windows,
+host-bound string ops) are detected at trace time and cached as such, so the
+fallback costs nothing at steady state.
+
+The reference has no analogue — its dask graphs are dynamically scheduled
+(SURVEY §2.3); this is the "compiled SPMD stages replace the dynamic
+scheduler" design of SURVEY §5.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import groupby as G
+from ..ops.kernels import comparable_data, unify_string_codes
+from ..plan.nodes import (
+    LogicalAggregate, LogicalFilter, LogicalJoin, LogicalProject, LogicalSort,
+    LogicalTableScan, LogicalUnion, LogicalValues, RelNode, RexCall,
+    RexInputRef, RexLiteral, RexNode,
+)
+from ..table import Column, Scalar, Table
+from .rex.evaluate import evaluate_predicate, evaluate_rex
+
+logger = logging.getLogger(__name__)
+
+_INT64_MIN = jnp.int64(-(2**63))
+_U64_MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+
+DEFAULT_GROUP_CAP = 4096
+_CACHE_LIMIT = 128
+
+# ops whose kernels are host-bound or non-deterministic: never compile
+_DENY_OPS = {"RAND", "RAND_INTEGER"}
+
+stats = {"compiles": 0, "hits": 0, "fallbacks": 0, "unsupported": 0,
+         "recompiles": 0}
+
+
+class Unsupported(Exception):
+    """Plan (or expression) outside the compilable subset."""
+
+
+# ---------------------------------------------------------------------------
+# fingerprinting
+# ---------------------------------------------------------------------------
+
+def _fp_rex(rex: RexNode) -> str:
+    if isinstance(rex, RexInputRef):
+        return f"@{rex.index}"
+    if isinstance(rex, RexLiteral):
+        return f"L{rex.stype.name}:{rex.value!r}"
+    if isinstance(rex, RexCall):
+        if rex.op in _DENY_OPS:
+            raise Unsupported(rex.op)
+        extra = ""
+        info = getattr(rex, "info", None)
+        if info is not None:
+            extra = f"!{getattr(info, 'name', info)}"
+        return (f"C{rex.op}{extra}[" + ",".join(_fp_rex(o) for o in rex.operands)
+                + f"]:{rex.stype.name}")
+    raise Unsupported(type(rex).__name__)
+
+
+def _fp_plan(rel: RelNode, context, scans: list) -> str:
+    """Serialize the plan for cache keying; collects scan tables."""
+    t = type(rel).__name__
+    schema = ";".join(f"{f.name}:{f.stype.name}" for f in rel.schema)
+    if isinstance(rel, LogicalTableScan):
+        entry = context.schema[rel.schema_name].tables[rel.table_name]
+        if entry.table is None:
+            raise Unsupported("view scan")
+        if entry.table.num_rows == 0:
+            raise Unsupported("empty table")
+        scans.append(((rel.schema_name, rel.table_name), entry.table))
+        return f"Scan({rel.schema_name}.{rel.table_name})[{schema}]"
+    if isinstance(rel, LogicalProject):
+        body = ",".join(_fp_rex(e) for e in rel.exprs)
+    elif isinstance(rel, LogicalFilter):
+        body = _fp_rex(rel.condition)
+    elif isinstance(rel, LogicalAggregate):
+        for agg in rel.aggs:
+            if agg.udaf is not None or agg.distinct:
+                raise Unsupported("udaf/distinct agg")
+            if agg.op in ("LISTAGG", "BIT_AND", "BIT_OR", "BIT_XOR"):
+                raise Unsupported(agg.op)
+        body = (f"g={rel.group_keys}|" + ",".join(
+            f"{a.op}({a.args})f{a.filter_arg}" for a in rel.aggs))
+    elif isinstance(rel, LogicalJoin):
+        if rel.join_type not in ("INNER", "LEFT", "RIGHT", "SEMI", "ANTI"):
+            raise Unsupported(rel.join_type)
+        if getattr(rel, "null_aware", False):
+            raise Unsupported("null-aware anti join")
+        cond = "T" if rel.condition is None else _fp_rex(rel.condition)
+        body = f"{rel.join_type}|{cond}"
+    elif isinstance(rel, LogicalSort):
+        body = (",".join(f"{c.index}{'a' if c.ascending else 'd'}"
+                         f"{'nf' if c.effective_nulls_first else 'nl'}"
+                         for c in rel.collation)
+                + f"|o={rel.offset}|l={rel.limit}")
+    elif isinstance(rel, LogicalUnion):
+        body = f"all={rel.all}"
+    elif isinstance(rel, LogicalValues):
+        body = repr([[lit.value for lit in row] for row in rel.rows])
+    else:
+        raise Unsupported(type(rel).__name__)
+    kids = ",".join(_fp_plan(i, context, scans) for i in rel.inputs)
+    return f"{t}({body})[{schema}]<{kids}>"
+
+
+def _fp_inputs(scans: list) -> tuple:
+    out = []
+    for _, tbl in scans:
+        cols = tuple(
+            (c.data.shape, str(c.data.dtype), c.mask is not None,
+             id(c.dictionary) if c.dictionary is not None else 0)
+            for c in tbl.columns)
+        out.append((id(tbl), cols))
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# in-trace kernels
+# ---------------------------------------------------------------------------
+
+def _orderable_int64(x: jax.Array) -> jax.Array:
+    """Total-order int64 key: floats via IEEE bit trick (-0.0 == +0.0)."""
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(jnp.float64) + 0.0  # canonicalize -0.0
+        b = jax.lax.bitcast_convert_type(x, jnp.int64)
+        return jnp.where(b < 0, (~b) ^ _INT64_MIN, b)
+    if x.dtype == jnp.bool_:
+        return x.astype(jnp.int64)
+    return x.astype(jnp.int64)
+
+
+def _mix64(z: jax.Array) -> jax.Array:
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+class _VT:
+    """A padded device table + row-validity mask (None = all rows valid)."""
+
+    __slots__ = ("table", "valid")
+
+    def __init__(self, table: Table, valid: Optional[jax.Array]):
+        self.table = table
+        self.valid = valid
+
+    @property
+    def n(self) -> int:
+        return self.table.num_rows
+
+    def vmask(self) -> jax.Array:
+        if self.valid is None:
+            return jnp.ones(self.n, dtype=bool)
+        return self.valid
+
+
+def _key_parts(cols: List[Column]) -> List[Tuple[jax.Array, jax.Array]]:
+    """(orderable int64 data with NULL->INT64_MIN, null flag) per key column."""
+    out = []
+    for c in cols:
+        d = _orderable_int64(comparable_data(c))
+        if c.mask is not None:
+            null = ~c.mask
+            d = jnp.where(null, _INT64_MIN, d)
+        else:
+            null = jnp.zeros(d.shape[0], dtype=bool)
+        out.append((d, null))
+    return out
+
+
+def _group_sort(parts, invalid_row: jax.Array) -> jax.Array:
+    """Stable permutation: invalid rows last; keys null-first ascending."""
+    arrays = []
+    for d, null in reversed(parts):
+        arrays.append(d)
+        # NULL sorts first (matching the eager factorize); the flag also
+        # disambiguates real INT64_MIN values from the NULL data sentinel
+        arrays.append(jnp.where(null, jnp.int8(0), jnp.int8(1)))
+    arrays.append(invalid_row.astype(jnp.int8))  # primary: valid rows first
+    return jnp.lexsort(arrays)
+
+
+def _traced_factorize(key_cols: List[Column], row_valid: Optional[jax.Array],
+                      cap: int):
+    """GROUP BY factorize inside a trace.
+
+    Returns (codes[n] in [0..cap] where cap = trash slot for invalid rows and
+    group overflow, first_rows[cap], num_groups device scalar). Group order
+    matches the eager factorize (null-first, ascending per key).
+    """
+    n = len(key_cols[0])
+    parts = _key_parts(key_cols)
+    invalid = jnp.zeros(n, dtype=bool) if row_valid is None else ~row_valid
+    perm = _group_sort(parts, invalid)
+
+    valid_sorted = ~invalid[perm]
+    boundary = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for d, null in parts:
+        ds, ns = d[perm], null[perm]
+        diff = jnp.concatenate([jnp.ones(1, bool),
+                                (ds[1:] != ds[:-1]) | (ns[1:] != ns[:-1])])
+        boundary = boundary | diff
+    boundary = boundary & valid_sorted
+    codes_sorted = jnp.cumsum(boundary.astype(jnp.int64)) - 1
+    # last valid row's code + 1; if no valid rows, 0
+    num_groups = jnp.where(valid_sorted.any(),
+                           jnp.max(jnp.where(valid_sorted, codes_sorted, -1)) + 1,
+                           0)
+    codes_sorted = jnp.where(valid_sorted, jnp.minimum(codes_sorted, cap), cap)
+    codes = jnp.zeros(n, dtype=jnp.int64).at[perm].set(codes_sorted)
+    first = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int64), codes, cap + 1)[:cap]
+    return codes, first, num_groups
+
+
+def _join_key_parts(lcols: List[Column], rcols: List[Column]):
+    """Per-key canonical int64 arrays on a shared domain for both sides."""
+    lparts, rparts = [], []
+    for lc, rc in zip(lcols, rcols):
+        if lc.stype.is_string or rc.stype.is_string:
+            la, ra = unify_string_codes([lc, rc])
+            la, ra = la.astype(jnp.int64), ra.astype(jnp.int64)
+        else:
+            dt = jnp.promote_types(lc.data.dtype, rc.data.dtype)
+            la = _orderable_int64(lc.data.astype(dt))
+            ra = _orderable_int64(rc.data.astype(dt))
+        lparts.append(la)
+        rparts.append(ra)
+    return lparts, rparts
+
+
+def _hash_parts(parts: List[jax.Array], key_valid: jax.Array) -> jax.Array:
+    h = jnp.full(parts[0].shape, _GOLDEN, dtype=jnp.uint64)
+    for p in parts:
+        h = _mix64(h + p.astype(jnp.uint64) + _GOLDEN)
+    h = jnp.where(h == _U64_MAX, _U64_MAX - np.uint64(1), h)
+    return jnp.where(key_valid, h, _U64_MAX)
+
+
+def _keys_valid(cols: List[Column], row_valid: Optional[jax.Array]) -> jax.Array:
+    v = jnp.ones(len(cols[0]), dtype=bool) if row_valid is None else row_valid
+    for c in cols:
+        if c.mask is not None:
+            v = v & c.mask
+    return v
+
+
+# ---------------------------------------------------------------------------
+# the tracer
+# ---------------------------------------------------------------------------
+
+class _Tracer:
+    def __init__(self, context, scan_tables: Dict[tuple, Table],
+                 caps: Dict[str, int]):
+        self.context = context
+        self.scan_tables = scan_tables
+        self.caps = caps
+        self.fallback: List[jax.Array] = []      # device bools -> eager rerun
+        self.ngroups: List[jax.Array] = []        # device ints, order = walk
+        self.ngroup_caps: List[int] = []          # matching static caps
+        self._agg_counter = 0
+
+    # -- dispatch ----------------------------------------------------------
+    def run(self, rel: RelNode) -> _VT:
+        m = getattr(self, "_" + type(rel).__name__, None)
+        if m is None:
+            raise Unsupported(type(rel).__name__)
+        return m(rel)
+
+    # -- nodes -------------------------------------------------------------
+    def _LogicalTableScan(self, rel: LogicalTableScan) -> _VT:
+        t = self.scan_tables[(rel.schema_name, rel.table_name)]
+        want = [f.name for f in rel.schema]
+        if t.names != want:
+            t = t.limit_to(want)
+        return _VT(t, None)
+
+    def _LogicalProject(self, rel: LogicalProject) -> _VT:
+        src = self.run(rel.input)
+        cols: List[Column] = []
+        for rex, f in zip(rel.exprs, rel.schema):
+            v = evaluate_rex(rex, src.table, None)
+            if isinstance(v, Scalar):
+                v = Column.from_scalar(v, src.n)
+            cols.append(v)
+        return _VT(Table([f.name for f in rel.schema], cols), src.valid)
+
+    def _LogicalFilter(self, rel: LogicalFilter) -> _VT:
+        src = self.run(rel.input)
+        mask = evaluate_predicate(rel.condition, src.table, None)
+        if isinstance(mask, bool):
+            if mask:
+                return src
+            return _VT(src.table, jnp.zeros(src.n, dtype=bool))
+        valid = mask if src.valid is None else (mask & src.valid)
+        return _VT(src.table, valid)
+
+    def _LogicalValues(self, rel: LogicalValues) -> _VT:
+        from .rel.executor import _values
+        return _VT(_values(rel, None), None)
+
+    def _LogicalAggregate(self, rel: LogicalAggregate) -> _VT:
+        src = self.run(rel.input)
+        n = src.n
+        out_cols: List[Column] = []
+        out_names = [f.name for f in rel.schema]
+
+        if not rel.group_keys:
+            for j, agg in enumerate(rel.aggs):
+                f = rel.schema[j]
+                col = src.table.columns[agg.args[0]] if agg.args else None
+                fmask = self._agg_filter(agg, src)
+                out_cols.append(G.segment_aggregate(
+                    agg.op, col, None, 1, f.stype, fmask, n))
+            return _VT(Table(out_names, out_cols), None)
+
+        tag = f"agg{self._agg_counter}"
+        self._agg_counter += 1
+        cap = min(self.caps.get(tag, DEFAULT_GROUP_CAP), n)
+        key_cols = [src.table.columns[i] for i in rel.group_keys]
+        codes, first, num_groups = _traced_factorize(key_cols, src.valid, cap)
+        self.ngroups.append(num_groups)
+        self.ngroup_caps.append(cap)
+
+        safe_first = jnp.clip(first, 0, n - 1)
+        for i, ki in enumerate(rel.group_keys):
+            out_cols.append(src.table.columns[ki].take(safe_first))
+        for j, agg in enumerate(rel.aggs):
+            f = rel.schema[len(rel.group_keys) + j]
+            col = src.table.columns[agg.args[0]] if agg.args else None
+            fmask = self._agg_filter(agg, src)
+            out_cols.append(G.segment_aggregate(
+                agg.op, col, codes, cap + 1, f.stype, fmask, n).slice(0, cap))
+        row_valid = jnp.arange(cap) < num_groups
+        return _VT(Table(out_names, out_cols), row_valid)
+
+    def _agg_filter(self, agg, src: _VT):
+        """Combined FILTER-clause + row-validity mask (None = all rows)."""
+        fmask = src.valid
+        if agg.filter_arg is not None:
+            fc = src.table.columns[agg.filter_arg]
+            fm = fc.data.astype(bool) & fc.valid_mask()
+            fmask = fm if fmask is None else (fmask & fm)
+        return fmask
+
+    def _LogicalSort(self, rel: LogicalSort) -> _VT:
+        src = self.run(rel.input)
+        n = src.n
+        valid = src.valid
+        table = src.table
+        need_compact = rel.offset is not None or rel.limit is not None
+        if rel.collation or (need_compact and valid is not None):
+            arrays = []
+            for c in reversed(rel.collation):
+                col = table.columns[c.index]
+                d = _orderable_int64(comparable_data(col))
+                if not c.ascending:
+                    # -INT64_MIN wraps; clamp before negating (merges the two
+                    # most-negative keys — indistinguishable in practice)
+                    d = -jnp.where(d == _INT64_MIN, _INT64_MIN + 1, d)
+                if col.mask is not None:
+                    nullkey = (~col.mask).astype(jnp.int8)
+                    if c.effective_nulls_first:
+                        nullkey = -nullkey
+                    arrays.append(d)
+                    arrays.append(nullkey)
+                else:
+                    arrays.append(d)
+            if valid is not None:
+                arrays.append((~valid).astype(jnp.int8))  # valid rows first
+            perm = jnp.lexsort(arrays)
+            table = table.take(perm)
+            if valid is not None:
+                count = jnp.sum(valid.astype(jnp.int64))
+                valid = jnp.arange(n) < count
+        start = rel.offset or 0
+        stop = n if rel.limit is None else min(start + rel.limit, n)
+        if start == 0 and stop == n:
+            return _VT(table, valid)
+        table = table.slice(start, stop)
+        if valid is not None:
+            count = jnp.sum(valid.astype(jnp.int64))
+            valid = jnp.arange(stop - start) < (count - start)
+        return _VT(table, valid)
+
+    def _LogicalUnion(self, rel: LogicalUnion) -> _VT:
+        from .rex.cast import cast_column
+        parts = [self.run(i) for i in rel.inputs_]
+        out_names = [f.name for f in rel.schema]
+        cols: List[Column] = []
+        for j, f in enumerate(rel.schema):
+            pieces = []
+            for p in parts:
+                c = p.table.columns[j]
+                if c.stype.name != f.stype.name:
+                    c = cast_column(c, f.stype)
+                pieces.append(c)
+            cols.append(_concat_columns(pieces, f.stype))
+        valids = [p.vmask() for p in parts]
+        valid = (None if all(p.valid is None for p in parts)
+                 else jnp.concatenate(valids))
+        out = _VT(Table(out_names, cols), valid)
+        if rel.all:
+            return out
+        # UNION DISTINCT: keep first occurrence of each distinct row
+        n = out.n
+        codes, first, _ = _traced_factorize(list(out.table.columns),
+                                            out.valid, n)
+        keep = jnp.clip(first, 0, n - 1)[codes] == jnp.arange(n)
+        keep = keep & out.vmask()
+        return _VT(out.table, keep)
+
+    def _LogicalJoin(self, rel: LogicalJoin) -> _VT:
+        from .rel.executor import _and_rex, _extract_equi_keys
+        left = self.run(rel.left)
+        right = self.run(rel.right)
+        equi, residual = _extract_equi_keys(rel)
+        jt = rel.join_type
+        if not equi:
+            raise Unsupported("non-equi/cross join")
+        if residual and jt != "INNER":
+            raise Unsupported("outer join with residual")
+
+        lk = [k for k, _ in equi]
+        rk = [k for _, k in equi]
+        out_names = [f.name for f in rel.schema]
+
+        if jt == "LEFT" or jt in ("SEMI", "ANTI"):
+            probe, build, probe_is_left = left, right, True
+            pk_cols = [left.table.columns[i] for i in lk]
+            bk_cols = [right.table.columns[i] for i in rk]
+        elif jt == "RIGHT":
+            probe, build, probe_is_left = right, left, False
+            pk_cols = [right.table.columns[i] for i in rk]
+            bk_cols = [left.table.columns[i] for i in lk]
+        else:  # INNER: probe the bigger side
+            if left.n >= right.n:
+                probe, build, probe_is_left = left, right, True
+                pk_cols = [left.table.columns[i] for i in lk]
+                bk_cols = [right.table.columns[i] for i in rk]
+            else:
+                probe, build, probe_is_left = right, left, False
+                pk_cols = [right.table.columns[i] for i in rk]
+                bk_cols = [left.table.columns[i] for i in lk]
+
+        if probe_is_left:
+            pparts, bparts = _join_key_parts(pk_cols, bk_cols)
+        else:
+            bparts, pparts = _join_key_parts(bk_cols, pk_cols)
+
+        pvalid = _keys_valid(pk_cols, probe.valid)
+        bvalid = _keys_valid(bk_cols, build.valid)
+        ph = _hash_parts(pparts, pvalid)
+        bh = _hash_parts(bparts, bvalid)
+
+        nb = build.n
+        order = jnp.argsort(bh)
+        bh_sorted = bh[order]
+        adj = (bh_sorted[1:] == bh_sorted[:-1]) & (bh_sorted[1:] != _U64_MAX)
+        if jt in ("INNER", "LEFT", "RIGHT"):
+            # build side must be unique on the key (covers hash collisions too)
+            self.fallback.append(adj.any())
+        else:
+            # duplicates fine for SEMI/ANTI; only hash collisions are fatal
+            coll = jnp.zeros((), dtype=bool)
+            for bp in bparts:
+                bps = bp[order]
+                coll = coll | (adj & (bps[1:] != bps[:-1])).any()
+            self.fallback.append(coll)
+
+        pos = jnp.searchsorted(bh_sorted, ph, side="left", method="sort")
+        in_range = pos < nb
+        pos_c = jnp.minimum(pos, nb - 1)
+        cand = order[pos_c]
+        match = in_range & pvalid & (bh_sorted[pos_c] == ph)
+        for pp, bp in zip(pparts, bparts):
+            match = match & (pp == bp[cand])
+
+        if jt == "SEMI":
+            return _VT(probe.table.with_names(out_names),
+                       probe.vmask() & match)
+        if jt == "ANTI":
+            return _VT(probe.table.with_names(out_names),
+                       probe.vmask() & ~match)
+
+        gathered = [c.take(cand) for c in build.table.columns]
+        if jt in ("LEFT", "RIGHT"):
+            gathered = [c.with_mask(c.valid_mask() & match) for c in gathered]
+        if probe_is_left:
+            cols = list(probe.table.columns) + gathered
+        else:
+            cols = gathered + list(probe.table.columns)
+        pairs = Table(out_names, cols)
+
+        if jt == "INNER":
+            valid = probe.vmask() & match
+            if residual:
+                pred = evaluate_predicate(_and_rex(residual), pairs, None)
+                if isinstance(pred, bool):
+                    pred = jnp.full(pairs.num_rows, pred)
+                valid = valid & pred
+            return _VT(pairs, valid)
+        # LEFT/RIGHT: every (valid) probe row survives
+        return _VT(pairs, probe.valid)
+
+
+def _concat_columns(pieces: List[Column], stype) -> Column:
+    if stype.is_string:
+        u = unify_string_codes(pieces)
+        # object dtype: a '<U' dictionary would coerce None (NULL) to 'None'
+        # on decode (Column._encode_strings uses object for the same reason)
+        union = np.unique(np.concatenate(
+            [c.dictionary.astype(str) for c in pieces])).astype(object)
+        data = jnp.concatenate([a.astype(jnp.int32) for a in u])
+        masks = None
+        if any(p.mask is not None for p in pieces):
+            masks = jnp.concatenate([p.valid_mask() for p in pieces])
+        return Column(data, stype, masks, union)
+    dt = pieces[0].data.dtype
+    for p in pieces[1:]:
+        dt = jnp.promote_types(dt, p.data.dtype)
+    data = jnp.concatenate([p.data.astype(dt) for p in pieces])
+    masks = None
+    if any(p.mask is not None for p in pieces):
+        masks = jnp.concatenate([p.valid_mask() for p in pieces])
+    return Column(data, pieces[0].stype, masks)
+
+
+# ---------------------------------------------------------------------------
+# compile + execute
+# ---------------------------------------------------------------------------
+
+class _Compiled:
+    __slots__ = ("fn", "scans", "spec", "meta", "caps", "key")
+
+    def __init__(self, fn, scans, spec, meta, caps, key):
+        self.fn = fn
+        self.scans = scans      # [(key, Table)] strong refs keep ids unique
+        self.spec = spec
+        self.meta = meta        # filled during first trace
+        self.caps = caps
+        self.key = key
+
+
+_cache: "OrderedDict[tuple, object]" = OrderedDict()
+# learned state per (plan, inputs) key: escalated group caps and runtime
+# verdicts, so steady state never repeats an overflow run or a known-eager
+# compiled attempt
+_learned_caps: Dict[tuple, Dict[str, int]] = {}
+_runtime_eager: set = set()
+_UNSUPPORTED = object()
+
+
+def _flatten_tables(scans) -> List[jax.Array]:
+    flat: List[jax.Array] = []
+    for _, tbl in scans:
+        for c in tbl.columns:
+            flat.append(c.data)
+            if c.mask is not None:
+                flat.append(c.mask)
+    return flat
+
+
+def _build(plan: RelNode, context, scans, caps: Dict[str, int], key):
+    """Create the jitted program for this plan + input spec."""
+    spec = []
+    for skey, tbl in scans:
+        spec.append((skey, [(c.stype, c.mask is not None, c.dictionary)
+                            for c in tbl.columns], tbl.names))
+    meta: dict = {}
+
+    def fn(*flat):
+        i = 0
+        tables: Dict[tuple, Table] = {}
+        for skey, colspec, names in spec:
+            cols = []
+            for stype, has_mask, dictionary in colspec:
+                data = flat[i]; i2 = i + 1
+                mask = flat[i2] if has_mask else None
+                i = i2 + 1 if has_mask else i2
+                cols.append(Column(data, stype, mask, dictionary))
+            tables[skey] = Table(names, cols)
+        tr = _Tracer(context, tables, caps)
+        out = tr.run(plan)
+        n = out.n
+        if out.valid is None:
+            count = jnp.int64(n)
+        else:
+            count = jnp.sum(out.valid.astype(jnp.int64))
+        fb = jnp.zeros((), dtype=bool)
+        for f in tr.fallback:
+            fb = fb | f
+        flags = jnp.stack([fb.astype(jnp.int64), count]
+                          + [g.astype(jnp.int64) for g in tr.ngroups])
+        meta["names"] = list(out.table.names)
+        meta["cols"] = [(c.stype, c.mask is not None, c.dictionary)
+                        for c in out.table.columns]
+        meta["has_valid"] = out.valid is not None
+        meta["ngroup_caps"] = list(tr.ngroup_caps)
+        meta["n_out"] = n
+        outs: List[jax.Array] = [flags]
+        for c in out.table.columns:
+            outs.append(c.data)
+            if c.mask is not None:
+                outs.append(c.mask)
+        if out.valid is not None:
+            outs.append(out.valid)
+        return tuple(outs)
+
+    return _Compiled(jax.jit(fn), list(scans), spec, meta, dict(caps), key)
+
+
+class _NeedsRecompile(Exception):
+    def __init__(self, caps):
+        self.caps = caps
+
+
+def _materialize(entry: _Compiled, outs) -> Table:
+    meta = entry.meta
+    flags = np.asarray(outs[0])
+    if flags[0]:
+        stats["fallbacks"] += 1
+        return None
+    ngroups = flags[2:]
+    new_caps = dict(entry.caps)
+    grew = False
+    for i, (ng, cap) in enumerate(zip(ngroups, meta["ngroup_caps"])):
+        if ng > cap:
+            need = 1 << (int(ng) - 1).bit_length()
+            new_caps[f"agg{i}"] = max(need, cap * 2)
+            grew = True
+    if grew:
+        raise _NeedsRecompile(new_caps)
+    count = int(flags[1])
+    idx = 1
+    cols: List[Column] = []
+    for stype, has_mask, dictionary in meta["cols"]:
+        data = outs[idx]; idx += 1
+        mask = None
+        if has_mask:
+            mask = outs[idx]; idx += 1
+        cols.append(Column(data, stype, mask, dictionary))
+    valid = outs[idx] if meta["has_valid"] else None
+    t = Table(meta["names"], cols)
+    if valid is not None and count < meta["n_out"]:
+        rows = jnp.nonzero(valid, size=count)[0]
+        t = t.take(rows)
+    return t
+
+
+def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
+    """Execute via the compiled pipeline; None => caller should run eager."""
+    if os.environ.get("DSQL_COMPILE", "1") == "0":
+        return None
+    scans: list = []
+    try:
+        plan_fp = _fp_plan(plan, context, scans)
+    except Unsupported as e:
+        logger.debug("not compilable: %s", e)
+        stats["unsupported"] += 1
+        return None
+    base_key = (plan_fp, _fp_inputs(scans))
+    if base_key in _runtime_eager:
+        stats["fallbacks"] += 1
+        return None
+    caps: Dict[str, int] = dict(_learned_caps.get(base_key, {}))
+    for _ in range(8):  # capacity-escalation bound
+        key = (base_key, tuple(sorted(caps.items())))
+        entry = _cache.get(key)
+        if entry is _UNSUPPORTED:
+            stats["unsupported"] += 1
+            return None
+        flat = _flatten_tables(scans)
+        if entry is None:
+            while len(_cache) >= _CACHE_LIMIT:
+                _cache.popitem(last=False)
+            try:
+                entry = _build(plan, context, scans, caps, key)
+                outs = entry.fn(*flat)  # first call traces & compiles
+            except Unsupported as e:
+                logger.debug("not compilable at trace time: %s", e)
+                _cache[key] = _UNSUPPORTED
+                stats["unsupported"] += 1
+                return None
+            except (jax.errors.TracerBoolConversionError,
+                    jax.errors.TracerArrayConversionError,
+                    jax.errors.ConcretizationTypeError,
+                    NotImplementedError) as e:
+                logger.debug("trace failed (%s); falling back", type(e).__name__)
+                _cache[key] = _UNSUPPORTED
+                stats["unsupported"] += 1
+                return None
+            stats["compiles"] += 1
+            _cache[key] = entry
+        else:
+            stats["hits"] += 1
+            _cache.move_to_end(key)
+            outs = entry.fn(*flat)
+        try:
+            result = _materialize(entry, outs)
+        except _NeedsRecompile as r:
+            stats["recompiles"] += 1
+            caps = r.caps
+            _learned_caps[base_key] = dict(caps)
+            continue
+        if result is None:
+            # runtime invariant failed (non-unique build / hash collision):
+            # data is keyed into base_key, so the verdict is stable — go
+            # straight to eager on every future call
+            _runtime_eager.add(base_key)
+        return result
+    return None

@@ -166,9 +166,21 @@ class Column:
             return 0
         return int((~self.mask).sum())
 
+    def _drop_allvalid_mask(self) -> "Column":
+        """Materialization-boundary normalization: all-True mask -> None.
+
+        Computation paths carry masks unconditionally (sync-free, traceable);
+        only here, where the host is about to look at the data anyway, is the
+        one-off ``mask.all()`` sync acceptable.
+        """
+        if self.mask is not None and bool(np.asarray(self.mask).all()):
+            return Column(self.data, self.stype, None, self.dictionary)
+        return self
+
     def with_mask(self, mask: Optional[jax.Array]) -> "Column":
-        if mask is not None and bool(mask.all()):
-            mask = None
+        # no all-valid -> None normalization here: that would be a blocking
+        # host sync per call (and a trace breaker under jit); materialization
+        # (to_numpy) drops all-valid masks instead
         return Column(self.data, self.stype, mask, self.dictionary)
 
     def cast_data(self, data: jax.Array, stype: Optional[SqlType] = None) -> "Column":
@@ -213,6 +225,7 @@ class Column:
     # -- host conversion ---------------------------------------------------
     def to_numpy(self) -> np.ndarray:
         """Host representation with rich types; nulls become None/NaN/NaT."""
+        self = self._drop_allvalid_mask()
         n = self.stype.name
         if self.stype.is_string:
             return self.decode()

@@ -1,0 +1,140 @@
+"""Compiled-pipeline executor tests: equivalence with the eager path,
+capacity escalation, runtime fallback, and plan caching.
+
+The compiled executor (physical/compiled.py) traces whole plans into one
+jitted program; these tests pin its semantics to the eager executor's over
+the shared fixture catalog (conftest.py) — the same differential strategy the
+reference uses between dask-sql and sqlite (test_compatibility.py:22-67).
+"""
+import os
+
+import pandas as pd
+import pytest
+
+from dask_sql_tpu.physical import compiled
+
+
+def _both_paths(c, query):
+    """Run query compiled and eager; return (compiled_df, eager_df)."""
+    comp = c.sql(query, return_futures=False)
+    prev = os.environ.get("DSQL_COMPILE")
+    os.environ["DSQL_COMPILE"] = "0"
+    try:
+        eager = c.sql(query, return_futures=False)
+    finally:
+        if prev is None:
+            del os.environ["DSQL_COMPILE"]
+        else:
+            os.environ["DSQL_COMPILE"] = prev
+    return comp, eager
+
+
+def _assert_same(comp: pd.DataFrame, eager: pd.DataFrame, ordered: bool):
+    if not ordered:
+        cols = list(comp.columns)
+        comp = comp.sort_values(cols, ignore_index=True)
+        eager = eager.sort_values(cols, ignore_index=True)
+    pd.testing.assert_frame_equal(comp.reset_index(drop=True),
+                                  eager.reset_index(drop=True),
+                                  check_dtype=False)
+
+
+QUERIES = [
+    ("SELECT * FROM df_simple", False),
+    ("SELECT a + b AS s, a * b AS p FROM df_simple WHERE a > 1", False),
+    ("SELECT a, SUM(b) AS sb, COUNT(*) AS n, AVG(b) AS ab FROM df GROUP BY a", False),
+    ("SELECT a, SUM(b) FILTER (WHERE b > 5) AS sb FROM df GROUP BY a", False),
+    ("SELECT SUM(b) AS sb, MIN(a) AS ma, MAX(b) AS mb FROM df", False),
+    ("SELECT user_id, SUM(b) AS x FROM user_table_1 GROUP BY user_id "
+     "HAVING SUM(b) > 2", False),
+    ("SELECT * FROM df WHERE b BETWEEN 2 AND 6 ORDER BY b DESC LIMIT 7", True),
+    ("SELECT * FROM df ORDER BY a ASC, b DESC LIMIT 5 OFFSET 3", True),
+    ("SELECT u1.user_id, u2.c FROM user_table_1 u1 "
+     "JOIN user_table_2 u2 ON u1.user_id = u2.user_id", False),
+    ("SELECT u1.user_id, u2.c FROM user_table_1 u1 "
+     "LEFT JOIN user_table_2 u2 ON u1.user_id = u2.user_id", False),
+    ("SELECT user_id FROM user_table_1 WHERE user_id IN "
+     "(SELECT user_id FROM user_table_2)", False),
+    ("SELECT lk_nullint FROM user_table_lk WHERE lk_nullint IS NOT NULL", False),
+    ("SELECT a FROM string_table WHERE a LIKE '%normal%'", False),
+    ("SELECT user_id FROM user_table_1 UNION SELECT user_id FROM user_table_2",
+     False),
+    ("SELECT user_id FROM user_table_1 UNION ALL "
+     "SELECT user_id FROM user_table_2", False),
+    ("SELECT CASE WHEN a > 1 THEN b ELSE -b END AS x FROM df_simple", False),
+    ("SELECT lk_nullint, COUNT(*) AS n FROM user_table_lk GROUP BY lk_nullint",
+     False),
+    ("SELECT c FROM user_table_nan WHERE c IS NOT NULL ORDER BY c", True),
+]
+
+
+@pytest.mark.parametrize("query,ordered", QUERIES)
+def test_compiled_matches_eager(c, query, ordered):
+    comp, eager = _both_paths(c, query)
+    _assert_same(comp, eager, ordered)
+
+
+def test_compiled_path_used(c):
+    before = compiled.stats["compiles"] + compiled.stats["hits"]
+    c.sql("SELECT a, SUM(b) AS s FROM df GROUP BY a")
+    after = compiled.stats["compiles"] + compiled.stats["hits"]
+    assert after == before + 1
+
+
+def test_left_join_actually_compiles(c):
+    """LEFT joins must run compiled (guards against trace-breaking syncs in
+    the masked-gather path)."""
+    before_uns = compiled.stats["unsupported"]
+    before = compiled.stats["compiles"] + compiled.stats["hits"]
+    c.sql("SELECT u1.user_id, u2.c FROM user_table_1 u1 "
+          "LEFT JOIN user_table_2 u2 ON u1.user_id = u2.user_id")
+    assert compiled.stats["compiles"] + compiled.stats["hits"] == before + 1
+    assert compiled.stats["unsupported"] == before_uns
+
+
+def test_cache_hit_on_repeat(c):
+    q = "SELECT a, COUNT(*) AS n FROM df WHERE b < 9 GROUP BY a"
+    c.sql(q)
+    hits = compiled.stats["hits"]
+    c.sql(q)
+    assert compiled.stats["hits"] == hits + 1
+
+
+def test_group_capacity_escalation(c, monkeypatch):
+    # force a tiny initial capacity: the first run overflows, the host
+    # recompiles with a doubled capacity, the result is still exact
+    monkeypatch.setattr(compiled, "DEFAULT_GROUP_CAP", 2)
+    rec = compiled.stats["recompiles"]
+    comp, eager = _both_paths(
+        c, "SELECT b, COUNT(*) AS n FROM df GROUP BY b")
+    _assert_same(comp, eager, ordered=False)
+    assert compiled.stats["recompiles"] > rec
+
+
+def test_runtime_fallback_nonunique_build(c):
+    # both sides have duplicate keys -> the unique-build invariant fails at
+    # runtime; the flags vector reroutes to the eager executor, which handles
+    # many-to-many joins
+    fb = compiled.stats["fallbacks"]
+    comp, eager = _both_paths(
+        c, "SELECT u1.b, u2.b AS b2 FROM user_table_1 u1 "
+           "JOIN user_table_1 u2 ON u1.user_id = u2.user_id")
+    _assert_same(comp, eager, ordered=False)
+    assert compiled.stats["fallbacks"] > fb
+
+
+def test_unsupported_plan_falls_back(c):
+    # window functions are outside the compiled subset
+    uns = compiled.stats["unsupported"]
+    r = c.sql("SELECT b, ROW_NUMBER() OVER (ORDER BY b) AS rn FROM df_simple",
+              return_futures=False)
+    assert list(r["rn"]) == [1, 2, 3]
+    assert compiled.stats["unsupported"] > uns
+
+
+def test_compiled_disabled_by_env(c, monkeypatch):
+    monkeypatch.setenv("DSQL_COMPILE", "0")
+    n = compiled.stats["compiles"] + compiled.stats["hits"]
+    r = c.sql("SELECT SUM(a) AS s FROM df_simple", return_futures=False)
+    assert r["s"][0] == 6
+    assert compiled.stats["compiles"] + compiled.stats["hits"] == n
