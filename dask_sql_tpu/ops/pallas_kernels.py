@@ -1,0 +1,111 @@
+"""Pallas TPU kernels for the engine's hot loops.
+
+The flagship kernel is a fused masked segmented reduction: SQL's
+``SELECT agg(x) ... GROUP BY k`` with a small static group domain (Q1 shape).
+Instead of XLA scatter-adds (slow on TPU) or a sort-based factorize, each
+row block builds its one-hot group matrix in VMEM and contracts it against
+the value rows on the MXU:
+
+    out[a, g] += sum_i vals[a, i] * (codes[i] == g & mask[i])
+
+The one-hot never touches HBM — it exists per block in VMEM — so the kernel
+is bandwidth-bound on the value stream alone, the MXU does the reduction,
+and the grid accumulates partials into the (A, G) output block across steps.
+
+The reference has no analogue (its groupby is a dask tree reduction over
+pandas partitions, aggregate.py:325-361); this is the SURVEY §7 "pallas
+kernels where XLA ops are awkward" item for groupby.
+
+On non-TPU backends the kernel runs in interpreter mode (tests), keeping one
+code path.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+BLOCK = 1024       # rows per grid step (lane-aligned multiple of 128)
+GROUP_TILE = 128   # group-axis padding (last-dim tile width)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _seg_matmul_kernel(codes_ref, mask_ref, vals_ref, out_ref):
+    """One grid step: accumulate this row block's per-group partial sums."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    codes = codes_ref[:]                      # (1, BLOCK) int32
+    mask = mask_ref[:]                        # (1, BLOCK) bool
+    g = out_ref.shape[1]
+    onehot = (codes.reshape(-1, 1)
+              == jax.lax.broadcasted_iota(jnp.int32, (codes.shape[1], g), 1))
+    onehot = jnp.where(mask.reshape(-1, 1), onehot, False)
+    onehot = onehot.astype(out_ref.dtype)
+    out_ref[:] += jnp.dot(vals_ref[:].astype(out_ref.dtype), onehot,
+                          preferred_element_type=out_ref.dtype)
+
+
+def segmented_sums(vals: jax.Array, codes: jax.Array, mask: jax.Array,
+                   num_groups: int, *, interpret: bool | None = None
+                   ) -> jax.Array:
+    """Masked segmented sums of A value rows over a static group domain.
+
+    vals: (A, n) float; codes: (n,) ints in [0, num_groups); mask: (n,) bool.
+    Returns (A, num_groups) sums of vals[:, i] over rows with codes[i]==g and
+    mask[i]. Jit/trace-safe; static shapes only.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    a, n = vals.shape
+    g_pad = max(GROUP_TILE, -(-num_groups // GROUP_TILE) * GROUP_TILE)
+    n_pad = -(-n // BLOCK) * BLOCK
+    if n_pad != n:
+        vals = jnp.pad(vals, ((0, 0), (0, n_pad - n)))
+        codes = jnp.pad(codes, (0, n_pad - n))
+        mask = jnp.pad(mask, (0, n_pad - n))  # padded rows masked out
+    codes = codes.astype(jnp.int32).reshape(1, n_pad)
+    mask = mask.reshape(1, n_pad)
+    out_dtype = vals.dtype if jnp.issubdtype(vals.dtype, jnp.floating) \
+        else jnp.float64
+    grid = n_pad // BLOCK
+    out = pl.pallas_call(
+        _seg_matmul_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK), lambda i: (0, i)),
+            pl.BlockSpec((1, BLOCK), lambda i: (0, i)),
+            pl.BlockSpec((a, BLOCK), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((a, g_pad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((a, g_pad), out_dtype),
+        interpret=interpret,
+    )(codes, mask, vals)
+    return out[:, :num_groups]
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "interpret"))
+def segmented_sums_jit(vals, codes, mask, num_groups, interpret=None):
+    return segmented_sums(vals, codes, mask, num_groups, interpret=interpret)
+
+
+def reference_segmented_sums(vals, codes, mask, num_groups):
+    """XLA scatter-based oracle for tests."""
+    w = jnp.where(mask, 1.0, 0.0)
+    out_dtype = vals.dtype if jnp.issubdtype(vals.dtype, jnp.floating) \
+        else jnp.float64
+    return jnp.stack([
+        jax.ops.segment_sum(vals[i].astype(out_dtype) * w, codes, num_groups)
+        for i in range(vals.shape[0])])

@@ -134,10 +134,12 @@ def _fp_inputs(scans: list) -> tuple:
     out = []
     for _, tbl in scans:
         cols = tuple(
-            (c.data.shape, str(c.data.dtype), c.mask is not None,
-             id(c.dictionary) if c.dictionary is not None else 0)
+            (c.data.shape, str(c.data.dtype), c.mask is not None)
             for c in tbl.columns)
-        out.append((id(tbl), cols))
+        # tbl.uid is monotonic and never reused (unlike id()), so a cache
+        # hit implies the exact Table traced against — including the string
+        # dictionaries embedded in the jitted program as constants
+        out.append((tbl.uid, cols))
     return tuple(out)
 
 
@@ -145,14 +147,38 @@ def _fp_inputs(scans: list) -> tuple:
 # in-trace kernels
 # ---------------------------------------------------------------------------
 
+def _float_class(x: jax.Array, null: Optional[jax.Array]) -> jax.Array:
+    """0 = NULL (first), 1 = ordinary value, 2 = NaN (last)."""
+    cls = jnp.where(jnp.isnan(x), jnp.int8(2), jnp.int8(1))
+    if null is not None:
+        cls = jnp.where(null, jnp.int8(0), cls)
+    return cls
+
+
+def _canon_f64(x: jax.Array) -> jax.Array:
+    """Canonical f64 sort/equality key: -0.0 -> +0.0, NaN -> 0 (class flag
+    disambiguates). No i64 bitcast — the TPU X64 rewrite can't do it."""
+    x = x.astype(jnp.float64) + 0.0
+    return jnp.where(jnp.isnan(x), 0.0, x)
+
+
+def _f64_hash_part(x: jax.Array) -> jax.Array:
+    """Deterministic u64 encoding of f64 for hashing without a 64-bit
+    bitcast: double-float (hi, lo) f32 split, each bitcast to i32 (supported
+    on TPU). ~48 mantissa bits — lossy encodings only add hash collisions,
+    which the join's collision flag catches; equality is verified on raw
+    values."""
+    x = _canon_f64(x)
+    hi = x.astype(jnp.float32)
+    lo = (x - hi.astype(jnp.float64)).astype(jnp.float32)
+    hi_b = jax.lax.bitcast_convert_type(hi, jnp.int32).astype(jnp.uint64)
+    lo_b = jax.lax.bitcast_convert_type(lo, jnp.int32).astype(jnp.uint64)
+    return (hi_b << np.uint64(32)) | (lo_b & np.uint64(0xFFFFFFFF))
+
+
 def _orderable_int64(x: jax.Array) -> jax.Array:
-    """Total-order int64 key: floats via IEEE bit trick (-0.0 == +0.0)."""
-    if jnp.issubdtype(x.dtype, jnp.floating):
-        x = x.astype(jnp.float64) + 0.0  # canonicalize -0.0
-        b = jax.lax.bitcast_convert_type(x, jnp.int64)
-        return jnp.where(b < 0, (~b) ^ _INT64_MIN, b)
-    if x.dtype == jnp.bool_:
-        return x.astype(jnp.int64)
+    """int64 key for non-float comparable data (ints, bools, dict ranks,
+    dates) — comparable_data already made the order numeric."""
     return x.astype(jnp.int64)
 
 
@@ -182,27 +208,41 @@ class _VT:
 
 
 def _key_parts(cols: List[Column]) -> List[Tuple[jax.Array, jax.Array]]:
-    """(orderable int64 data with NULL->INT64_MIN, null flag) per key column."""
+    """(data, class flag) per key column for grouping/dedup.
+
+    data is canonical f64 for float columns (no 64-bit bitcast on TPU) or
+    int64 with a NULL sentinel otherwise; the int8 class flag orders
+    NULL(0) < values(1) < NaN(2) and disambiguates sentinel collisions.
+    Equality of (data, flag) == SQL group equality (-0.0 == +0.0,
+    NaNs grouped together, NULLs grouped together).
+    """
     out = []
     for c in cols:
-        d = _orderable_int64(comparable_data(c))
-        if c.mask is not None:
-            null = ~c.mask
-            d = jnp.where(null, _INT64_MIN, d)
+        raw = comparable_data(c)
+        null = (~c.mask) if c.mask is not None else None
+        if jnp.issubdtype(raw.dtype, jnp.floating):
+            d = _canon_f64(raw)
+            flag = _float_class(raw, null)
+            if null is not None:
+                d = jnp.where(null, 0.0, d)
         else:
-            null = jnp.zeros(d.shape[0], dtype=bool)
-        out.append((d, null))
+            d = _orderable_int64(raw)
+            if null is not None:
+                d = jnp.where(null, _INT64_MIN, d)
+                flag = jnp.where(null, jnp.int8(0), jnp.int8(1))
+            else:
+                flag = jnp.ones(d.shape[0], dtype=jnp.int8)
+        out.append((d, flag))
     return out
 
 
 def _group_sort(parts, invalid_row: jax.Array) -> jax.Array:
     """Stable permutation: invalid rows last; keys null-first ascending."""
     arrays = []
-    for d, null in reversed(parts):
+    for d, flag in reversed(parts):
         arrays.append(d)
-        # NULL sorts first (matching the eager factorize); the flag also
-        # disambiguates real INT64_MIN values from the NULL data sentinel
-        arrays.append(jnp.where(null, jnp.int8(0), jnp.int8(1)))
+        # flag is more significant than data: NULL first, NaN last
+        arrays.append(flag)
     arrays.append(invalid_row.astype(jnp.int8))  # primary: valid rows first
     return jnp.lexsort(arrays)
 
@@ -239,26 +279,75 @@ def _traced_factorize(key_cols: List[Column], row_valid: Optional[jax.Array],
     return codes, first, num_groups
 
 
+STATIC_DOMAIN_CAP = 4096
+
+
+def _try_static_codes(cols: List[Column]):
+    """Direct group codes when every key has a statically-enumerable domain
+    (dictionary-encoded strings, booleans). Returns (codes[n] int64 in
+    [0, domain), domain) or None. Code order == eager group order
+    (NULL slot first, then dictionary rank order)."""
+    domain = 1
+    parts: List[Tuple[jax.Array, int]] = []
+    for c in cols:
+        nullable = c.mask is not None
+        if c.stype.is_string:
+            size = len(c.dictionary)
+            code = c.dict_ranks().data.astype(jnp.int64)
+        elif c.data.dtype == jnp.bool_:
+            size = 2
+            code = c.data.astype(jnp.int64)
+        else:
+            return None
+        if nullable:
+            code = jnp.where(c.mask, code + 1, 0)
+            size += 1
+        domain *= max(size, 1)
+        if domain > STATIC_DOMAIN_CAP:
+            return None
+        parts.append((code, size))
+    combined = parts[0][0]
+    for code, size in parts[1:]:
+        combined = combined * size + code
+    return combined, domain
+
+
 def _join_key_parts(lcols: List[Column], rcols: List[Column]):
-    """Per-key canonical int64 arrays on a shared domain for both sides."""
+    """Per-key (hash part u64, raw verify array) on a shared domain.
+
+    Hash parts may be lossy for f64 (double-float encoding); match
+    verification always compares the raw arrays, so a lossy hash can only
+    add collisions (caught by the collision flag), never wrong matches.
+    """
     lparts, rparts = [], []
     for lc, rc in zip(lcols, rcols):
         if lc.stype.is_string or rc.stype.is_string:
             la, ra = unify_string_codes([lc, rc])
             la, ra = la.astype(jnp.int64), ra.astype(jnp.int64)
+            lh, rh = la.astype(jnp.uint64), ra.astype(jnp.uint64)
         else:
             dt = jnp.promote_types(lc.data.dtype, rc.data.dtype)
-            la = _orderable_int64(lc.data.astype(dt))
-            ra = _orderable_int64(rc.data.astype(dt))
-        lparts.append(la)
-        rparts.append(ra)
+            la = lc.data.astype(dt)
+            ra = rc.data.astype(dt)
+            if jnp.issubdtype(dt, jnp.floating):
+                # verify arrays keep NaN as NaN (NaN joins nothing, matching
+                # the eager path); only the hash canonicalizes NaN, and the
+                # resulting extra collisions trip the conservative flags
+                la = la.astype(jnp.float64) + 0.0
+                ra = ra.astype(jnp.float64) + 0.0
+                lh, rh = _f64_hash_part(la), _f64_hash_part(ra)
+            else:
+                la, ra = _orderable_int64(la), _orderable_int64(ra)
+                lh, rh = la.astype(jnp.uint64), ra.astype(jnp.uint64)
+        lparts.append((lh, la))
+        rparts.append((rh, ra))
     return lparts, rparts
 
 
-def _hash_parts(parts: List[jax.Array], key_valid: jax.Array) -> jax.Array:
-    h = jnp.full(parts[0].shape, _GOLDEN, dtype=jnp.uint64)
-    for p in parts:
-        h = _mix64(h + p.astype(jnp.uint64) + _GOLDEN)
+def _hash_parts(parts, key_valid: jax.Array) -> jax.Array:
+    h = jnp.full(parts[0][0].shape, _GOLDEN, dtype=jnp.uint64)
+    for hp, _ in parts:
+        h = _mix64(h + hp + _GOLDEN)
     h = jnp.where(h == _U64_MAX, _U64_MAX - np.uint64(1), h)
     return jnp.where(key_valid, h, _U64_MAX)
 
@@ -340,10 +429,14 @@ class _Tracer:
                     agg.op, col, None, 1, f.stype, fmask, n))
             return _VT(Table(out_names, out_cols), None)
 
+        key_cols = [src.table.columns[i] for i in rel.group_keys]
+        static = _try_static_codes(key_cols)
+        if static is not None:
+            return self._static_domain_aggregate(rel, src, static)
+
         tag = f"agg{self._agg_counter}"
         self._agg_counter += 1
         cap = min(self.caps.get(tag, DEFAULT_GROUP_CAP), n)
-        key_cols = [src.table.columns[i] for i in rel.group_keys]
         codes, first, num_groups = _traced_factorize(key_cols, src.valid, cap)
         self.ngroups.append(num_groups)
         self.ngroup_caps.append(cap)
@@ -359,6 +452,83 @@ class _Tracer:
                 agg.op, col, codes, cap + 1, f.stype, fmask, n).slice(0, cap))
         row_valid = jnp.arange(cap) < num_groups
         return _VT(Table(out_names, out_cols), row_valid)
+
+    def _static_domain_aggregate(self, rel, src: _VT, static) -> _VT:
+        """GROUP BY over a statically-enumerable key domain (dict-encoded
+        strings / booleans): codes come straight from dictionary ranks — no
+        sort, no capacity escalation — and the SUM/COUNT/AVG family reduces
+        via the MXU one-hot kernel (ops/pallas_kernels.py) on TPU.
+
+        This is the TPC-H Q1 shape: GROUP BY returnflag, linestatus.
+        """
+        from ..ops import pallas_kernels as pk
+        codes_raw, domain = static
+        n = src.n
+        rv = src.valid
+        codes = codes_raw if rv is None else jnp.where(rv, codes_raw, domain)
+        ones = jnp.ones(n, dtype=jnp.int64) if rv is None \
+            else rv.astype(jnp.int64)
+        occupancy = jax.ops.segment_sum(ones, codes, domain + 1)[:domain] > 0
+        first = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int64), codes,
+                                    domain + 1)[:domain]
+        safe_first = jnp.clip(first, 0, n - 1)
+
+        out_names = [f.name for f in rel.schema]
+        out_cols: List[Column] = [
+            src.table.columns[ki].take(safe_first) for ki in rel.group_keys]
+
+        # split aggregates: MXU-reducible (SUM family over floats) vs rest
+        mxu_rows, mxu_slots = [], []
+        use_pallas = pk._on_tpu() or os.environ.get("DSQL_PALLAS") == "force"
+        results: List[Optional[Column]] = [None] * len(rel.aggs)
+        for j, agg in enumerate(rel.aggs):
+            f = rel.schema[len(rel.group_keys) + j]
+            col = src.table.columns[agg.args[0]] if agg.args else None
+            fmask = self._agg_filter(agg, src)
+            if (agg.op in ("SUM", "$SUM0", "AVG", "COUNT")
+                    and (col is None
+                         or jnp.issubdtype(col.data.dtype, jnp.floating))
+                    and domain <= 256):
+                if col is None:
+                    vmask = jnp.ones(n, bool) if fmask is None else fmask
+                    vrow = vmask.astype(jnp.float64)
+                    crow = vrow
+                else:
+                    vmask = col.valid_mask() if fmask is None \
+                        else (col.valid_mask() & fmask)
+                    vrow = jnp.where(vmask, col.data.astype(jnp.float64), 0.0)
+                    crow = vmask.astype(jnp.float64)
+                mxu_slots.append((j, agg, f, len(mxu_rows)))
+                mxu_rows.append(vrow)
+                mxu_rows.append(crow)
+            else:
+                results[j] = G.segment_aggregate(
+                    agg.op, col, codes, domain + 1, f.stype, fmask,
+                    n).slice(0, domain)
+
+        if mxu_slots:
+            stack = jnp.stack(mxu_rows)
+            kmask = jnp.ones(n, bool) if rv is None else rv
+            reducer = pk.segmented_sums if use_pallas \
+                else pk.reference_segmented_sums
+            red = reducer(stack, codes, kmask, domain + 1)[:, :domain]
+            from ..types import physical_dtype
+            for j, agg, f, row0 in mxu_slots:
+                sums, counts = red[row0], red[row0 + 1]
+                has = counts > 0
+                if agg.op == "COUNT":
+                    results[j] = Column(counts.astype(jnp.int64), f.stype, None)
+                elif agg.op == "$SUM0":
+                    results[j] = Column(
+                        sums.astype(physical_dtype(f.stype)), f.stype, None)
+                elif agg.op == "SUM":
+                    results[j] = Column(
+                        sums.astype(physical_dtype(f.stype)), f.stype, has)
+                else:  # AVG
+                    results[j] = Column(sums / jnp.maximum(counts, 1.0),
+                                        f.stype, has)
+        out_cols.extend(results)
+        return _VT(Table(out_names, out_cols), occupancy)
 
     def _agg_filter(self, agg, src: _VT):
         """Combined FILTER-clause + row-validity mask (None = all rows)."""
@@ -379,19 +549,28 @@ class _Tracer:
             arrays = []
             for c in reversed(rel.collation):
                 col = table.columns[c.index]
-                d = _orderable_int64(comparable_data(col))
-                if not c.ascending:
-                    # -INT64_MIN wraps; clamp before negating (merges the two
-                    # most-negative keys — indistinguishable in practice)
-                    d = -jnp.where(d == _INT64_MIN, _INT64_MIN + 1, d)
+                raw = comparable_data(col)
+                if jnp.issubdtype(raw.dtype, jnp.floating):
+                    d = _canon_f64(raw)
+                    # NaN sorts last in BOTH directions (XLA/eager semantics:
+                    # -NaN is still NaN) — the flag is never negated
+                    nanflag = jnp.isnan(raw).astype(jnp.int8)
+                    if not c.ascending:
+                        d = -d
+                    arrays.append(d)
+                    arrays.append(nanflag)
+                else:
+                    d = _orderable_int64(raw)
+                    if not c.ascending:
+                        # -INT64_MIN wraps; clamp before negating (merges the
+                        # two most-negative keys — unobservable in practice)
+                        d = -jnp.where(d == _INT64_MIN, _INT64_MIN + 1, d)
+                    arrays.append(d)
                 if col.mask is not None:
                     nullkey = (~col.mask).astype(jnp.int8)
                     if c.effective_nulls_first:
                         nullkey = -nullkey
-                    arrays.append(d)
                     arrays.append(nullkey)
-                else:
-                    arrays.append(d)
             if valid is not None:
                 arrays.append((~valid).astype(jnp.int8))  # valid rows first
             perm = jnp.lexsort(arrays)
@@ -412,6 +591,7 @@ class _Tracer:
     def _LogicalUnion(self, rel: LogicalUnion) -> _VT:
         from .rex.cast import cast_column
         parts = [self.run(i) for i in rel.inputs_]
+        from ..ops.join import concat_columns
         out_names = [f.name for f in rel.schema]
         cols: List[Column] = []
         for j, f in enumerate(rel.schema):
@@ -421,7 +601,7 @@ class _Tracer:
                 if c.stype.name != f.stype.name:
                     c = cast_column(c, f.stype)
                 pieces.append(c)
-            cols.append(_concat_columns(pieces, f.stype))
+            cols.append(concat_columns(pieces))
         valids = [p.vmask() for p in parts]
         valid = (None if all(p.valid is None for p in parts)
                  else jnp.concatenate(valids))
@@ -489,9 +669,9 @@ class _Tracer:
         else:
             # duplicates fine for SEMI/ANTI; only hash collisions are fatal
             coll = jnp.zeros((), dtype=bool)
-            for bp in bparts:
-                bps = bp[order]
-                coll = coll | (adj & (bps[1:] != bps[:-1])).any()
+            for _, raw in bparts:
+                raws = raw[order]
+                coll = coll | (adj & (raws[1:] != raws[:-1])).any()
             self.fallback.append(coll)
 
         pos = jnp.searchsorted(bh_sorted, ph, side="left", method="sort")
@@ -499,8 +679,8 @@ class _Tracer:
         pos_c = jnp.minimum(pos, nb - 1)
         cand = order[pos_c]
         match = in_range & pvalid & (bh_sorted[pos_c] == ph)
-        for pp, bp in zip(pparts, bparts):
-            match = match & (pp == bp[cand])
+        for (_, praw), (_, braw) in zip(pparts, bparts):
+            match = match & (praw == braw[cand])
 
         if jt == "SEMI":
             return _VT(probe.table.with_names(out_names),
@@ -530,26 +710,6 @@ class _Tracer:
         return _VT(pairs, probe.valid)
 
 
-def _concat_columns(pieces: List[Column], stype) -> Column:
-    if stype.is_string:
-        u = unify_string_codes(pieces)
-        # object dtype: a '<U' dictionary would coerce None (NULL) to 'None'
-        # on decode (Column._encode_strings uses object for the same reason)
-        union = np.unique(np.concatenate(
-            [c.dictionary.astype(str) for c in pieces])).astype(object)
-        data = jnp.concatenate([a.astype(jnp.int32) for a in u])
-        masks = None
-        if any(p.mask is not None for p in pieces):
-            masks = jnp.concatenate([p.valid_mask() for p in pieces])
-        return Column(data, stype, masks, union)
-    dt = pieces[0].data.dtype
-    for p in pieces[1:]:
-        dt = jnp.promote_types(dt, p.data.dtype)
-    data = jnp.concatenate([p.data.astype(dt) for p in pieces])
-    masks = None
-    if any(p.mask is not None for p in pieces):
-        masks = jnp.concatenate([p.valid_mask() for p in pieces])
-    return Column(data, pieces[0].stype, masks)
 
 
 # ---------------------------------------------------------------------------
@@ -557,11 +717,10 @@ def _concat_columns(pieces: List[Column], stype) -> Column:
 # ---------------------------------------------------------------------------
 
 class _Compiled:
-    __slots__ = ("fn", "scans", "spec", "meta", "caps", "key")
+    __slots__ = ("fn", "spec", "meta", "caps", "key")
 
-    def __init__(self, fn, scans, spec, meta, caps, key):
+    def __init__(self, fn, spec, meta, caps, key):
         self.fn = fn
-        self.scans = scans      # [(key, Table)] strong refs keep ids unique
         self.spec = spec
         self.meta = meta        # filled during first trace
         self.caps = caps
@@ -571,10 +730,17 @@ class _Compiled:
 _cache: "OrderedDict[tuple, object]" = OrderedDict()
 # learned state per (plan, inputs) key: escalated group caps and runtime
 # verdicts, so steady state never repeats an overflow run or a known-eager
-# compiled attempt
-_learned_caps: Dict[tuple, Dict[str, int]] = {}
-_runtime_eager: set = set()
+# compiled attempt; bounded like the program cache
+_learned_caps: "OrderedDict[tuple, Dict[str, int]]" = OrderedDict()
+_runtime_eager: "OrderedDict[tuple, bool]" = OrderedDict()
+_LEARNED_LIMIT = 1024
 _UNSUPPORTED = object()
+
+
+def _bounded_put(d: OrderedDict, key, value):
+    while len(d) >= _LEARNED_LIMIT:
+        d.popitem(last=False)
+    d[key] = value
 
 
 def _flatten_tables(scans) -> List[jax.Array]:
@@ -633,7 +799,7 @@ def _build(plan: RelNode, context, scans, caps: Dict[str, int], key):
             outs.append(out.valid)
         return tuple(outs)
 
-    return _Compiled(jax.jit(fn), list(scans), spec, meta, dict(caps), key)
+    return _Compiled(jax.jit(fn), spec, meta, dict(caps), key)
 
 
 class _NeedsRecompile(Exception):
@@ -708,11 +874,15 @@ def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
                 _cache[key] = _UNSUPPORTED
                 stats["unsupported"] += 1
                 return None
-            except (jax.errors.TracerBoolConversionError,
-                    jax.errors.TracerArrayConversionError,
-                    jax.errors.ConcretizationTypeError,
-                    NotImplementedError) as e:
-                logger.debug("trace failed (%s); falling back", type(e).__name__)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                # trace-time concretization errors (host-bound kernels) and
+                # backend compile failures (e.g. an op outside the TPU X64
+                # rewrite) both land here: the eager path is the answer
+                logger.warning("compiled path failed for this plan (%s: %s); "
+                               "using eager executor", type(e).__name__,
+                               str(e)[:200])
                 _cache[key] = _UNSUPPORTED
                 stats["unsupported"] += 1
                 return None
@@ -727,12 +897,12 @@ def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
         except _NeedsRecompile as r:
             stats["recompiles"] += 1
             caps = r.caps
-            _learned_caps[base_key] = dict(caps)
+            _bounded_put(_learned_caps, base_key, dict(caps))
             continue
         if result is None:
             # runtime invariant failed (non-unique build / hash collision):
             # data is keyed into base_key, so the verdict is stable — go
             # straight to eager on every future call
-            _runtime_eager.add(base_key)
+            _bounded_put(_runtime_eager, base_key, True)
         return result
     return None

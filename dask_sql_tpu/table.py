@@ -22,6 +22,7 @@ mask is still authoritative).
 from __future__ import annotations
 
 import datetime
+import itertools
 from dataclasses import dataclass, replace
 from typing import Any, Iterable, Optional, Sequence, Union
 
@@ -290,12 +291,17 @@ def _as_mask(mask) -> Optional[jax.Array]:
 class Table:
     """An ordered, named collection of equal-length Columns."""
 
-    __slots__ = ("names", "columns")
+    __slots__ = ("names", "columns", "uid")
+
+    _uid_counter = itertools.count()
 
     def __init__(self, names: Sequence[str], columns: Sequence[Column]):
         assert len(names) == len(columns)
         self.names = list(names)
         self.columns = list(columns)
+        # monotonic identity: unlike id(), never reused after GC — the
+        # compiled-query cache keys on it (physical/compiled.py)
+        self.uid = next(Table._uid_counter)
 
     # -- constructors ------------------------------------------------------
     @staticmethod
@@ -374,9 +380,20 @@ class Table:
     def to_pandas(self):
         import pandas as pd
 
+        # fetch every device buffer in ONE transfer: per-column np.asarray
+        # would pay a tunnel round trip each over a remote TPU
+        buffers = []
+        for col in self.columns:
+            buffers.append(col.data)
+            if col.mask is not None:
+                buffers.append(col.mask)
+        fetched = iter(jax.device_get(buffers))
         data = {}
         for name, col in zip(self.names, self.columns):
-            data[name] = col.to_numpy()
+            host_data = next(fetched)
+            host_mask = next(fetched) if col.mask is not None else None
+            host_col = Column(host_data, col.stype, host_mask, col.dictionary)
+            data[name] = host_col.to_numpy()
         df = pd.DataFrame(data, columns=list(self.names))
         return df
 

@@ -138,3 +138,28 @@ def test_compiled_disabled_by_env(c, monkeypatch):
     r = c.sql("SELECT SUM(a) AS s FROM df_simple", return_futures=False)
     assert r["s"][0] == 6
     assert compiled.stats["compiles"] + compiled.stats["hits"] == n
+
+
+def test_nan_join_key_matches_nothing(c):
+    """NaN join keys must not match 0.0 (or other NaNs) on the compiled path
+    (the hash canonicalizes NaN but match verification must not)."""
+    import pandas as pd
+    c.create_table("nan_l", pd.DataFrame({"x": [0.0, 1.0], "y": [0.0, 1.0]}))
+    c.create_table("nan_r", pd.DataFrame({"f": [0.0, 1.0], "tag": [10, 20]}))
+    comp, eager = _both_paths(
+        c, "SELECT t.f2, r.tag FROM (SELECT x / y AS f2 FROM nan_l) t "
+           "JOIN nan_r r ON t.f2 = r.f")
+    _assert_same(comp, eager, ordered=False)
+    assert len(comp) == 1  # only the 1.0 row; 0/0 -> NaN matches nothing
+
+
+def test_desc_sort_nan_last_both_paths(c):
+    """ORDER BY ... DESC keeps NaN last (XLA semantics) on both executors."""
+    import pandas as pd
+    c.create_table("nan_s", pd.DataFrame({"x": [0.0, 2.0, 1.0],
+                                          "y": [0.0, 1.0, 1.0]}))
+    comp, eager = _both_paths(
+        c, "SELECT x / y AS r FROM nan_s ORDER BY r DESC")
+    import numpy as np
+    assert np.isnan(comp["r"].iloc[-1]) and np.isnan(eager["r"].iloc[-1])
+    _assert_same(comp, eager, ordered=True)
