@@ -80,7 +80,8 @@ class Context:
         always lives on device here, so it is a no-op flag.
         """
         schema_name = schema_name or self.schema_name
-        table = InputUtil.to_table(input_table, file_format=format, **kwargs)
+        table = InputUtil.to_table(input_table, file_format=format,
+                                   table_name=table_name, **kwargs)
         entry = TableEntry(table=table, statistics=statistics,
                            filepath=input_table if isinstance(input_table, str) else None,
                            gpu=gpu)

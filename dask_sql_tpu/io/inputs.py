@@ -100,7 +100,8 @@ class LocationInputPlugin(BaseInputPlugin):
             file_format = os.path.splitext(input_item)[1].lstrip(".")
         file_format = (file_format or "").lower()
         read_kwargs = {k: v for k, v in kwargs.items()
-                       if k not in ("persist", "schema_name", "statistics", "gpu")}
+                       if k not in ("persist", "schema_name", "statistics",
+                                    "gpu", "table_name")}
         if file_format in ("csv", "tsv", "txt"):
             if file_format == "tsv" and "sep" not in read_kwargs:
                 read_kwargs["sep"] = "\t"
@@ -119,17 +120,16 @@ class LocationInputPlugin(BaseInputPlugin):
 
 
 class HiveInputPlugin(BaseInputPlugin):
-    """Gated: pyhive not available in this image (reference hive.py:25-284)."""
+    """Hive metastore tables via any DB-API-ish cursor (io/hive.py holds the
+    DESCRIBE FORMATTED machinery, reference hive.py:25-284)."""
 
     def is_correct_input(self, input_item, **kwargs):
-        try:
-            from pyhive import hive  # noqa: F401
-        except ImportError:
-            return False
-        return type(input_item).__module__.startswith("pyhive")
+        from .hive import HiveInput
+        return HiveInput.is_hive_like(input_item, **kwargs)
 
     def to_table(self, input_item, **kwargs):
-        raise NotImplementedError("Hive ingestion requires pyhive")
+        from .hive import HiveInput
+        return HiveInput.to_table(input_item, **kwargs)
 
 
 class IntakeCatalogInputPlugin(BaseInputPlugin):

@@ -66,9 +66,38 @@ def segmented_sums(vals: jax.Array, codes: jax.Array, mask: jax.Array,
     vals: (A, n) float; codes: (n,) ints in [0, num_groups); mask: (n,) bool.
     Returns (A, num_groups) sums of vals[:, i] over rows with codes[i]==g and
     mask[i]. Jit/trace-safe; static shapes only.
+
+    Non-finite safety: the one-hot contraction computes vals * 0 for other
+    groups, and NaN/Inf * 0 == NaN would poison every group. The kernel
+    therefore sums sanitized values and per-group NaN/+Inf/-Inf indicator
+    rows, and reconstitutes IEEE semantics afterwards.
     """
     if interpret is None:
         interpret = not _on_tpu()
+    a, n = vals.shape
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        isnan = jnp.isnan(vals)
+        ispos = jnp.isposinf(vals)
+        isneg = jnp.isneginf(vals)
+        clean = jnp.where(isnan | ispos | isneg, 0.0, vals)
+        stacked = jnp.concatenate([
+            clean, isnan.astype(vals.dtype), ispos.astype(vals.dtype),
+            isneg.astype(vals.dtype)])
+        sums = _segmented_sums_finite(stacked, codes, mask, num_groups,
+                                      interpret)
+        clean_s, nan_s, pos_s, neg_s = (sums[:a], sums[a:2 * a],
+                                        sums[2 * a:3 * a], sums[3 * a:])
+        out = clean_s
+        out = jnp.where(pos_s > 0, jnp.inf, out)
+        out = jnp.where(neg_s > 0, -jnp.inf, out)
+        out = jnp.where((pos_s > 0) & (neg_s > 0), jnp.nan, out)
+        out = jnp.where(nan_s > 0, jnp.nan, out)
+        return out
+    return _segmented_sums_finite(vals, codes, mask, num_groups, interpret)
+
+
+def _segmented_sums_finite(vals: jax.Array, codes: jax.Array, mask: jax.Array,
+                           num_groups: int, interpret: bool) -> jax.Array:
     a, n = vals.shape
     g_pad = max(GROUP_TILE, -(-num_groups // GROUP_TILE) * GROUP_TILE)
     n_pad = -(-n // BLOCK) * BLOCK
@@ -102,10 +131,11 @@ def segmented_sums_jit(vals, codes, mask, num_groups, interpret=None):
 
 
 def reference_segmented_sums(vals, codes, mask, num_groups):
-    """XLA scatter-based oracle for tests."""
-    w = jnp.where(mask, 1.0, 0.0)
+    """XLA scatter-based oracle for tests (where, not multiply, so masked
+    NaN rows contribute nothing)."""
     out_dtype = vals.dtype if jnp.issubdtype(vals.dtype, jnp.floating) \
         else jnp.float64
     return jnp.stack([
-        jax.ops.segment_sum(vals[i].astype(out_dtype) * w, codes, num_groups)
+        jax.ops.segment_sum(
+            jnp.where(mask, vals[i].astype(out_dtype), 0), codes, num_groups)
         for i in range(vals.shape[0])])

@@ -328,7 +328,8 @@ class Binder:
         plan = self._apply_order_limit(plan, Scope.from_fields(plan.schema, None),
                                        q.order_by, q.limit, q.offset,
                                        output_fields=out_fields,
-                                       hidden_sort=hidden_sort)
+                                       hidden_sort=hidden_sort,
+                                       proj_items=proj_items)
         return plan
 
     # ------------------------------------------------------------- relations
@@ -765,26 +766,18 @@ class Binder:
     # ---------------------------------------------------------- order / limit
     def _apply_order_limit(self, plan: RelNode, scope: Scope, order_by,
                            limit_e, offset_e, output_fields: List[Field],
-                           hidden_sort: int = 0) -> RelNode:
+                           hidden_sort: int = 0, proj_items=None) -> RelNode:
         collation: List[SortCollation] = []
         n_visible = len(output_fields)
         hidden_used = 0
+        out_names = [f.name for f in output_fields]
         for k in order_by:
-            idx = None
-            if isinstance(k.expr, A.Literal) and isinstance(k.expr.value, int):
-                idx = k.expr.value - 1
-            elif isinstance(k.expr, A.ColumnRef) and len(k.expr.parts) == 1:
-                name = k.expr.parts[0]
-                names = [f.name for f in output_fields]
-                if name in names:
-                    idx = names.index(name)
-                else:
-                    low = [n.lower() for n in names]
-                    if name.lower() in low:
-                        idx = low.index(name.lower())
+            # MUST mirror the resolution the binder used when deciding which
+            # keys get hidden sort columns (_hidden_sort_exprs), or the
+            # hidden-column accounting below goes out of sync
+            idx = self._resolve_orderby_item(k.expr, proj_items or [],
+                                             out_names)
             if idx is None:
-                # match a visible column structurally? fall back to hidden cols
-                names = [f.name for f in plan.schema]
                 # hidden sort columns were appended in order of unresolved keys
                 idx = n_visible + hidden_used
                 hidden_used += 1

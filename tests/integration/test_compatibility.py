@@ -168,3 +168,182 @@ def test_complex_query():
            HAVING COUNT(*) > 1
            ORDER BY dot DESC
            LIMIT 5""", check_row_order=False, a=a, b=b)
+
+
+# ---------------------------------------------------------------------------
+# randomized scenario classes mirroring the rest of the reference suite
+# (test_compatibility.py:98-920): dedup, in/between, cross join, typed agg
+# matrices, window frames, nested queries, CTE integration
+# ---------------------------------------------------------------------------
+
+def test_drop_duplicates_rand():
+    a = make_rand_df(100, a=int, b=(str, 30))
+    eq_sqlite("SELECT DISTINCT b, a FROM a", a=a)
+    eq_sqlite("SELECT DISTINCT a FROM a", a=a)
+
+
+def test_order_by_no_limit_rand():
+    a = make_rand_df(100, a=(int, 40), b=(str, 40))
+    eq_sqlite("SELECT * FROM a ORDER BY a NULLS FIRST, b NULLS LAST",
+              check_row_order=True, a=a)
+
+
+def test_in_between_rand():
+    a = make_rand_df(50, a=(int, 10), b=(str, 10))
+    eq_sqlite("SELECT * FROM a WHERE a IN (2, 4, 6)", a=a)
+    eq_sqlite("SELECT * FROM a WHERE a BETWEEN 3 AND 7", a=a)
+    eq_sqlite("SELECT * FROM a WHERE a NOT BETWEEN 3 AND 7", a=a)
+
+
+def test_join_cross_rand():
+    a = make_rand_df(10, a=int, b=(str, 3))
+    b = make_rand_df(5, c=float, d=(int, 2))
+    eq_sqlite("SELECT * FROM a CROSS JOIN b", a=a, b=b)
+
+
+def test_agg_count_typed_rand():
+    a = make_rand_df(
+        100, a=int, b=str, c=float, d=(int, 50), e=(str, 50), f=(float, 50))
+    eq_sqlite(
+        """
+        SELECT a, b, COUNT(c) AS c_ct, COUNT(d) AS d_ct, COUNT(e) AS e_ct,
+               COUNT(f) AS f_ct, COUNT(*) AS n
+        FROM a GROUP BY a, b
+        """, a=a)
+
+
+def test_agg_sum_avg_typed_rand():
+    a = make_rand_df(100, a=int, b=str, c=float, d=(int, 50), f=(float, 50))
+    eq_sqlite(
+        """
+        SELECT a, b, SUM(c) AS sc, SUM(d) AS sd, SUM(f) AS sf,
+               AVG(c) AS ac, AVG(d) AS ad, AVG(f) AS af
+        FROM a GROUP BY a, b
+        """, a=a)
+    eq_sqlite("SELECT SUM(c) AS sc, AVG(d) AS ad FROM a", a=a)
+
+
+def test_agg_min_max_typed_rand():
+    a = make_rand_df(
+        100, a=int, b=str, c=float, d=(int, 50), e=(str, 50), f=(float, 50))
+    eq_sqlite(
+        """
+        SELECT a, b, MIN(c) AS mc, MAX(c) AS xc, MIN(d) AS md, MAX(d) AS xd,
+               MIN(e) AS me, MAX(e) AS xe, MIN(f) AS mf, MAX(f) AS xf
+        FROM a GROUP BY a, b
+        """, a=a)
+    eq_sqlite("SELECT MIN(c) AS mc, MAX(e) AS xe FROM a", a=a)
+
+
+def test_window_row_number_rand():
+    a = make_rand_df(10, a=int, b=(float, 5))
+    eq_sqlite(
+        """
+        SELECT *,
+            ROW_NUMBER() OVER (ORDER BY a ASC, b DESC NULLS FIRST) AS a1,
+            ROW_NUMBER() OVER (ORDER BY a ASC, b ASC NULLS LAST) AS a2,
+            ROW_NUMBER() OVER (PARTITION BY a ORDER BY b DESC NULLS FIRST) AS a3
+        FROM a
+        ORDER BY a, b NULLS FIRST
+        """, check_row_order=True, a=a)
+
+
+def test_window_row_number_partition_rand():
+    a = make_rand_df(100, a=(int, 50), b=(str, 50), c=(int, 30), e=float)
+    eq_sqlite(
+        """
+        SELECT *,
+            ROW_NUMBER() OVER (ORDER BY a ASC NULLS LAST, b DESC NULLS FIRST, e) AS a1,
+            ROW_NUMBER() OVER (PARTITION BY a, c ORDER BY b DESC NULLS LAST, e) AS a2
+        FROM a
+        ORDER BY a NULLS FIRST, b NULLS FIRST, c NULLS FIRST, e
+        """, check_row_order=True, a=a)
+
+
+def test_window_sum_avg_frames_rand():
+    a = make_rand_df(100, a=float, b=(int, 50), c=(str, 50))
+    for func in ["SUM", "AVG"]:
+        eq_sqlite(
+            f"""
+            SELECT a, b,
+                {func}(b) OVER () AS a1,
+                {func}(b) OVER (PARTITION BY c) AS a2,
+                {func}(b+a) OVER (PARTITION BY c, b) AS a3,
+                {func}(b+a) OVER (PARTITION BY b ORDER BY a NULLS FIRST
+                    ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS a4,
+                {func}(b+a) OVER (PARTITION BY b ORDER BY a DESC NULLS FIRST
+                    ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) AS a5
+            FROM a
+            ORDER BY a NULLS FIRST, b NULLS FIRST, c NULLS FIRST
+            """, a=a)
+
+
+def test_window_irregular_frames_rand():
+    a = make_rand_df(100, a=float, b=(int, 50), c=(str, 50))
+    eq_sqlite(
+        """
+        SELECT a, b,
+            SUM(b) OVER (PARTITION BY b ORDER BY a DESC NULLS FIRST
+                ROWS BETWEEN 2 PRECEDING AND 1 PRECEDING) AS a6,
+            SUM(b) OVER (PARTITION BY b ORDER BY a DESC NULLS FIRST
+                ROWS BETWEEN 2 PRECEDING AND 1 FOLLOWING) AS a7,
+            SUM(b) OVER (PARTITION BY b ORDER BY a DESC NULLS FIRST
+                ROWS BETWEEN 2 PRECEDING AND UNBOUNDED FOLLOWING) AS a8
+        FROM a
+        ORDER BY a NULLS FIRST, b NULLS FIRST, c NULLS FIRST
+        """, a=a)
+
+
+def test_window_min_max_rand():
+    a = make_rand_df(100, a=float, b=(int, 50), c=(str, 50))
+    for func in ["MIN", "MAX"]:
+        eq_sqlite(
+            f"""
+            SELECT a, b,
+                {func}(b) OVER () AS a1,
+                {func}(b) OVER (PARTITION BY c) AS a2,
+                {func}(b+a) OVER (PARTITION BY b ORDER BY a NULLS FIRST
+                    ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS a4
+            FROM a
+            ORDER BY a NULLS FIRST, b NULLS FIRST, c NULLS FIRST
+            """, a=a)
+
+
+def test_window_count_rand():
+    a = make_rand_df(100, a=float, b=(int, 50), c=(str, 50))
+    eq_sqlite(
+        """
+        SELECT a, b,
+            COUNT(b) OVER () AS a1,
+            COUNT(b) OVER (PARTITION BY c) AS a2,
+            COUNT(b) OVER (PARTITION BY b ORDER BY a NULLS FIRST
+                ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS a4
+        FROM a
+        ORDER BY a NULLS FIRST, b NULLS FIRST, c NULLS FIRST
+        """, a=a)
+
+
+def test_nested_query_rand():
+    a = make_rand_df(100, a=(int, 40), b=(str, 40), c=(float, 40))
+    eq_sqlite(
+        """
+        SELECT b, AVG(c) AS cc FROM
+            (SELECT * FROM a WHERE a >= 2) t
+        GROUP BY b
+        """, a=a)
+
+
+def test_integration_cte_join_rand():
+    a = make_rand_df(100, a=int, b=str, c=float, d=int, e=bool, f=str, h=float)
+    eq_sqlite(
+        """
+        WITH
+            a1 AS (SELECT a+1 AS a, b, c FROM a),
+            a2 AS (SELECT a, MAX(b) AS b_max, AVG(c) AS c_avg FROM a GROUP BY a),
+            a3 AS (SELECT d+2 AS d, f, h FROM a WHERE e)
+        SELECT a1.a, b, c, b_max, c_avg, f, h FROM a1
+            INNER JOIN a2 ON a1.a = a2.a
+            LEFT JOIN a3 ON a1.a = a3.d
+        ORDER BY a1.a NULLS FIRST, b NULLS FIRST, c NULLS FIRST,
+                 f NULLS FIRST, h NULLS FIRST
+        """, check_row_order=True, a=a)

@@ -1,0 +1,138 @@
+"""Hive ingestion against a fake metastore cursor.
+
+The reference tests this against dockerized Hive containers
+(tests/integration/test_hive.py:37-60); no docker here, so the cursor is a
+test double that replays the exact DESCRIBE FORMATTED / SHOW PARTITIONS wire
+rows a Hive server produces, over real parquet/csv files on disk. This
+exercises the full parse -> read -> partition-column -> Table path.
+"""
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu.io.hive import (
+    hive_table_to_pandas, parse_hive_table_description,
+)
+
+
+class FakeHiveCursor:
+    """Replays canned (key, value, value2) rows like a pyhive cursor."""
+
+    def __init__(self, responses):
+        self.responses = responses
+        self._last = []
+
+    def execute(self, sql):
+        sql = " ".join(sql.split())
+        self._last = self.responses.get(sql, [])
+        return self  # sqlalchemy style: result has fetchall
+
+    def fetchall(self):
+        return self._last
+
+
+def _describe_rows(columns, location, input_format, partitions=None,
+                   field_delim=None, detail_extra=()):
+    rows = [("# col_name", "data_type", "comment")]
+    rows += [(name, typ, "") for name, typ in columns]
+    if partitions:
+        rows.append(("# Partition Information", "", ""))
+        rows.append(("# col_name", "data_type", "comment"))
+        rows += [(name, typ, "") for name, typ in partitions]
+    rows.append(("# Detailed Table Information", "", ""))
+    rows.append(("Location", location, ""))
+    rows += list(detail_extra)  # e.g. Partition Value for partition describes
+    rows.append(("# Storage Information", "", ""))
+    rows.append(("InputFormat", input_format, ""))
+    if field_delim:
+        rows.append(("Storage Desc Params", "", ""))
+        rows.append(("", "field.delim", field_delim))
+    return rows
+
+
+PARQUET_FMT = "org.apache.hadoop.hive.ql.io.parquet.MapredParquetInputFormat"
+TEXT_FMT = "org.apache.hadoop.mapred.TextInputFormat"
+
+
+@pytest.fixture()
+def parquet_table(tmp_path):
+    d = tmp_path / "warehouse" / "tbl"
+    d.mkdir(parents=True)
+    df = pd.DataFrame({"i": np.arange(5, dtype="int32"),
+                       "s": ["a", "b", "c", "d", "e"]})
+    df.to_parquet(d / "part-0000")
+    return d, df
+
+
+def test_describe_formatted_parse(parquet_table):
+    d, _ = parquet_table
+    cursor = FakeHiveCursor({
+        "USE default": [],
+        "DESCRIBE FORMATTED tbl": _describe_rows(
+            [("i", "int"), ("s", "string")], str(d), PARQUET_FMT),
+    })
+    cols, table, storage, parts = parse_hive_table_description(
+        cursor, "default", "tbl")
+    assert list(cols) == ["i", "s"]
+    assert table["Location"] == str(d)
+    assert storage["InputFormat"] == PARQUET_FMT
+    assert parts == {}
+
+
+def test_unpartitioned_parquet(parquet_table):
+    d, df = parquet_table
+    cursor = FakeHiveCursor({
+        "USE default": [],
+        "DESCRIBE FORMATTED tbl": _describe_rows(
+            [("i", "int"), ("s", "string")], str(d), PARQUET_FMT),
+    })
+    got = hive_table_to_pandas(cursor, "tbl")
+    pd.testing.assert_frame_equal(got.reset_index(drop=True), df,
+                                  check_dtype=False)
+
+
+def test_partitioned_csv(tmp_path):
+    base = tmp_path / "wh" / "t2"
+    frames = {}
+    for part in ("p=1", "p=2"):
+        d = base / part
+        d.mkdir(parents=True)
+        df = pd.DataFrame({"x": [1, 2] if part == "p=1" else [3, 4]})
+        df.to_csv(d / "data-000", index=False, header=False)
+        frames[part] = df
+    common = dict(field_delim=",")
+    cursor = FakeHiveCursor({
+        "USE default": [],
+        "DESCRIBE FORMATTED t2": _describe_rows(
+            [("x", "bigint")], str(base), TEXT_FMT,
+            partitions=[("p", "int")], **common),
+        "SHOW PARTITIONS t2": [("p=1",), ("p=2",)],
+        "DESCRIBE FORMATTED t2 PARTITION (p=1)": _describe_rows(
+            [("x", "bigint")], str(base / "p=1"), TEXT_FMT,
+            detail_extra=[("Partition Value", "[1]", "")], **common),
+        "DESCRIBE FORMATTED t2 PARTITION (p=2)": _describe_rows(
+            [("x", "bigint")], str(base / "p=2"), TEXT_FMT,
+            detail_extra=[("Partition Value", "[2]", "")], **common),
+    })
+    got = hive_table_to_pandas(cursor, "t2")
+    assert got["x"].tolist() == [1, 2, 3, 4]
+    assert got["p"].tolist() == [1, 1, 2, 2]
+    assert got["p"].dtype == np.int32
+
+
+def test_hive_table_through_context_sql(parquet_table):
+    d, _ = parquet_table
+    cursor = FakeHiveCursor({
+        "USE default": [],
+        "DESCRIBE FORMATTED tbl": _describe_rows(
+            [("i", "int"), ("s", "string")], str(d), PARQUET_FMT),
+    })
+    c = Context()
+    c.create_table("hive_t", cursor, format="hive", hive_table_name="tbl")
+    r = c.sql("SELECT s, i FROM hive_t WHERE i >= 3 ORDER BY i",
+              return_futures=False)
+    assert r["s"].tolist() == ["d", "e"]
+    assert r["i"].tolist() == [3, 4]

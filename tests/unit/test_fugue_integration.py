@@ -1,0 +1,20 @@
+"""Fugue integration gating: module imports without fugue and raises a clear
+error on use (reference integrations/fugue.py surface)."""
+import pytest
+
+from dask_sql_tpu.integrations import fugue as fg
+
+
+def test_surface_exists():
+    assert hasattr(fg, "TpuSQLEngine")
+    assert hasattr(fg, "TpuSQLExecutionEngine")
+    assert hasattr(fg, "fsql_tpu")
+
+
+def test_gated_without_fugue():
+    if fg._HAS_FUGUE:
+        pytest.skip("fugue installed; gating not applicable")
+    with pytest.raises(ImportError, match="fugue"):
+        fg.fsql_tpu("SELECT 1")
+    with pytest.raises(ImportError, match="fugue"):
+        fg.TpuSQLEngine()

@@ -38,3 +38,33 @@ def test_padding_rows_do_not_leak():
     got = pk.segmented_sums(vals, codes, mask, 2, interpret=True)
     assert got[0, 0] == n
     assert got[0, 1] == 0
+
+
+def test_nan_inf_isolated_to_their_groups():
+    """NaN/Inf values must only affect their own group (NaN*0 == NaN would
+    otherwise poison every group through the one-hot contraction)."""
+    vals = jnp.asarray([[np.nan, 1.0, 2.0, 3.0, np.inf, -np.inf, 5.0, 6.0]])
+    codes = jnp.asarray([0, 1, 1, 1, 2, 3, 4, 4])
+    mask = jnp.ones(8, dtype=bool)
+    got = np.asarray(pk.segmented_sums(vals, codes, mask, 5, interpret=True))
+    assert np.isnan(got[0, 0])
+    assert got[0, 1] == 6.0
+    assert got[0, 2] == np.inf
+    assert got[0, 3] == -np.inf
+    assert got[0, 4] == 11.0
+
+
+def test_masked_nan_contributes_nothing():
+    vals = jnp.asarray([[np.nan, 1.0, 2.0]])
+    codes = jnp.asarray([0, 0, 1])
+    mask = jnp.asarray([False, True, True])
+    got = np.asarray(pk.segmented_sums(vals, codes, mask, 2, interpret=True))
+    assert got[0, 0] == 1.0 and got[0, 1] == 2.0
+
+
+def test_posneg_inf_same_group_is_nan():
+    vals = jnp.asarray([[np.inf, -np.inf, 1.0]])
+    codes = jnp.asarray([0, 0, 1])
+    mask = jnp.ones(3, dtype=bool)
+    got = np.asarray(pk.segmented_sums(vals, codes, mask, 2, interpret=True))
+    assert np.isnan(got[0, 0]) and got[0, 1] == 1.0
