@@ -45,10 +45,15 @@ class Context:
 
     DEFAULT_SCHEMA_NAME = "root"
 
-    def __init__(self, logging_level=logging.INFO):
+    def __init__(self, logging_level=logging.INFO, mesh=None):
+        """``mesh``: a 1-D ``jax.sharding.Mesh`` — tables registered on this
+        context are row-sharded over it and queries compile to SPMD programs
+        with XLA-inserted collectives (the distributed mode; the reference
+        attaches a dask cluster instead, SURVEY §2.3)."""
         self.schema_name = self.DEFAULT_SCHEMA_NAME
         self.schema = {self.DEFAULT_SCHEMA_NAME: SchemaContainer(self.DEFAULT_SCHEMA_NAME)}
         self.server = None
+        self.mesh = mesh
         # register default input plugins (reference context.py:113-119 order)
         for plugin in (DeviceTableInputPlugin(), PandasLikeInputPlugin(),
                        DictInputPlugin(), ArrowInputPlugin(), HiveInputPlugin(),
@@ -82,9 +87,13 @@ class Context:
         schema_name = schema_name or self.schema_name
         table = InputUtil.to_table(input_table, file_format=format,
                                    table_name=table_name, **kwargs)
+        row_valid = None
+        if self.mesh is not None:
+            from .parallel.mesh import shard_table_with_validity
+            table, row_valid = shard_table_with_validity(table, self.mesh)
         entry = TableEntry(table=table, statistics=statistics,
                            filepath=input_table if isinstance(input_table, str) else None,
-                           gpu=gpu)
+                           gpu=gpu, row_valid=row_valid)
         self.schema[schema_name].tables[table_name.lower()] = entry
         logger.debug("Registered table %s.%s (%d rows)", schema_name,
                      table_name, table.num_rows)

@@ -32,6 +32,9 @@ class TableEntry:
     statistics: Optional[dict] = None
     filepath: Optional[str] = None
     gpu: bool = False              # parity flag only
+    # mesh mode: columns are padded to device-count divisibility and
+    # row-sharded; row_valid (same sharding) marks the real rows
+    row_valid: Any = None
 
 
 class SchemaContainer:

@@ -128,25 +128,53 @@ def _normalize_location(loc: str) -> str:
     return os.path.join(loc, "[A-Za-z0-9-]*")
 
 
+def _expand_files(pattern: str):
+    """Glob expansion: fsspec for remote URIs (hdfs://, s3://, dbfs://),
+    stdlib glob for local paths. Returns (filesystem_or_None, paths)."""
+    if "://" in pattern:
+        import fsspec
+        fs, _, paths = fsspec.get_fs_token_paths(pattern)
+        return fs, (paths or [pattern])
+    return None, (sorted(_glob.glob(pattern)) or [pattern])
+
+
 def _read_location(location: str, fmt: str, column_information: Dict,
                    storage_information: Dict, **kwargs) -> pd.DataFrame:
     pattern = _normalize_location(location)
-    paths = sorted(_glob.glob(pattern)) or [pattern]
+    fs, paths = _expand_files(pattern)
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def _open(p):
+        if fs is None:
+            yield p
+        else:
+            with fs.open(p, "rb") as f:
+                yield f
+
+    def _read_all(reader):
+        out = []
+        for p in paths:
+            with _open(p) as f:
+                out.append(reader(f))
+        return out
+
     if fmt in ("TextInputFormat", "SequenceFileInputFormat"):
         sep = storage_information.get("Storage Desc Params", {}) \
             .get("field.delim", ",")
-        frames = [pd.read_csv(p, sep=sep, header=None, **kwargs)
-                  for p in paths]
+        frames = _read_all(
+            lambda f: pd.read_csv(f, sep=sep, header=None, **kwargs))
     elif fmt in ("ParquetInputFormat", "MapredParquetInputFormat"):
         # restrict to the metastore's columns: partition directories like
         # .../col=3/ would otherwise surface as extra columns and the
         # positional rename below would mislabel data (reference hive.py:115)
         kwargs.setdefault("columns", list(column_information.keys()))
-        frames = [pd.read_parquet(p, **kwargs) for p in paths]
+        frames = _read_all(lambda f: pd.read_parquet(f, **kwargs))
     elif fmt == "OrcInputFormat":
-        frames = [pd.read_orc(p, **kwargs) for p in paths]
+        frames = _read_all(lambda f: pd.read_orc(f, **kwargs))
     elif fmt == "JsonInputFormat":
-        frames = [pd.read_json(p, lines=True, **kwargs) for p in paths]
+        frames = _read_all(lambda f: pd.read_json(f, lines=True, **kwargs))
     else:
         raise AttributeError(f"Do not understand hive's table format {fmt}")
     df = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
@@ -200,8 +228,13 @@ class HiveInput:
         if kwargs.get("format") == "hive" or kwargs.get("file_format") == "hive":
             return True
         mod = type(input_item).__module__ or ""
-        if mod.startswith("pyhive") or mod.startswith("sqlalchemy"):
+        if mod.startswith("pyhive"):
             return True
+        # sqlalchemy: only a Connection is a hive-capable cursor (reference
+        # hive.py:28-36); Engines/Sessions etc. must not be claimed here
+        if mod.startswith("sqlalchemy"):
+            return (type(input_item).__name__ == "Connection"
+                    and hasattr(input_item, "execute"))
         return False
 
     @staticmethod

@@ -74,26 +74,9 @@ def segmented_sums(vals: jax.Array, codes: jax.Array, mask: jax.Array,
     """
     if interpret is None:
         interpret = not _on_tpu()
-    a, n = vals.shape
-    if jnp.issubdtype(vals.dtype, jnp.floating):
-        isnan = jnp.isnan(vals)
-        ispos = jnp.isposinf(vals)
-        isneg = jnp.isneginf(vals)
-        clean = jnp.where(isnan | ispos | isneg, 0.0, vals)
-        stacked = jnp.concatenate([
-            clean, isnan.astype(vals.dtype), ispos.astype(vals.dtype),
-            isneg.astype(vals.dtype)])
-        sums = _segmented_sums_finite(stacked, codes, mask, num_groups,
-                                      interpret)
-        clean_s, nan_s, pos_s, neg_s = (sums[:a], sums[a:2 * a],
-                                        sums[2 * a:3 * a], sums[3 * a:])
-        out = clean_s
-        out = jnp.where(pos_s > 0, jnp.inf, out)
-        out = jnp.where(neg_s > 0, -jnp.inf, out)
-        out = jnp.where((pos_s > 0) & (neg_s > 0), jnp.nan, out)
-        out = jnp.where(nan_s > 0, jnp.nan, out)
-        return out
-    return _segmented_sums_finite(vals, codes, mask, num_groups, interpret)
+    return _nonfinite_safe(
+        lambda v, c, m, g: _segmented_sums_finite(v, c, m, g, interpret)
+    )(vals, codes, mask, num_groups)
 
 
 def _segmented_sums_finite(vals: jax.Array, codes: jax.Array, mask: jax.Array,
@@ -128,6 +111,87 @@ def _segmented_sums_finite(vals: jax.Array, codes: jax.Array, mask: jax.Array,
 @functools.partial(jax.jit, static_argnames=("num_groups", "interpret"))
 def segmented_sums_jit(vals, codes, mask, num_groups, interpret=None):
     return segmented_sums(vals, codes, mask, num_groups, interpret=interpret)
+
+
+def segmented_sums_xla_blocked(vals: jax.Array, codes: jax.Array,
+                               mask: jax.Array, num_groups: int,
+                               block: int = 4096) -> jax.Array:
+    """One-hot contraction via an XLA scan over row blocks.
+
+    Same math as the pallas kernel but in plain XLA: Mosaic has no 64-bit
+    support, so this is the f64 path on TPU (X64 emulation is exact). The
+    per-block one-hot lives only inside the scan body — peak memory is one
+    (block, G) tile, not (n, G). Callers handle non-finite values
+    (segmented_sums_dispatch wraps with the sanitize/indicator machinery).
+    """
+    a, n = vals.shape
+    out_dtype = vals.dtype if jnp.issubdtype(vals.dtype, jnp.floating) \
+        else jnp.float64
+    n_pad = -(-max(n, 1) // block) * block
+    if n_pad != n:
+        vals = jnp.pad(vals, ((0, 0), (0, n_pad - n)))
+        codes = jnp.pad(codes, (0, n_pad - n))
+        mask = jnp.pad(mask, (0, n_pad - n))
+    nb = n_pad // block
+    vb = vals.reshape(a, nb, block).transpose(1, 0, 2).astype(out_dtype)
+    cb = codes.astype(jnp.int32).reshape(nb, block)
+    mb = mask.reshape(nb, block)
+
+    def step(acc, xs):
+        v, c, m = xs
+        onehot = (c[:, None]
+                  == jax.lax.broadcasted_iota(jnp.int32, (block, num_groups), 1))
+        onehot = jnp.where(m[:, None], onehot, False).astype(out_dtype)
+        return acc + jnp.dot(v, onehot, preferred_element_type=out_dtype), None
+
+    acc0 = jnp.zeros((a, num_groups), dtype=out_dtype)
+    out, _ = jax.lax.scan(step, acc0, (vb, cb, mb))
+    return out
+
+
+def segmented_sums_dispatch(vals: jax.Array, codes: jax.Array,
+                            mask: jax.Array, num_groups: int) -> jax.Array:
+    """Backend policy for the static-domain groupby reduction.
+
+    - DSQL_PALLAS=force: pallas kernel (interpreted off-TPU) — test hook.
+    - TPU + 32-bit floats: the pallas MXU kernel.
+    - TPU + 64-bit: XLA blocked contraction (Mosaic has no 64-bit types).
+    - otherwise (CPU/GPU): XLA scatter segment-sum, which is fine there.
+    Non-finite safety is applied here once for every backend.
+    """
+    import os
+
+    forced = os.environ.get("DSQL_PALLAS") == "force"
+    if forced:
+        return segmented_sums(vals, codes, mask, num_groups,
+                              interpret=not _on_tpu())
+    if _on_tpu():
+        if vals.dtype == jnp.float32:
+            return segmented_sums(vals, codes, mask, num_groups,
+                                  interpret=False)
+        return _nonfinite_safe(segmented_sums_xla_blocked)(
+            vals, codes, mask, num_groups)
+    return reference_segmented_sums(vals, codes, mask, num_groups)
+
+
+def _nonfinite_safe(backend):
+    """Wrap a sanitized-sum backend with NaN/Inf indicator reassembly."""
+    def wrapped(vals, codes, mask, num_groups):
+        if not jnp.issubdtype(vals.dtype, jnp.floating):
+            return backend(vals, codes, mask, num_groups)
+        from .sorted_agg import ieee_reassemble
+        a = vals.shape[0]
+        isnan = jnp.isnan(vals)
+        ispos = jnp.isposinf(vals)
+        isneg = jnp.isneginf(vals)
+        clean = jnp.where(isnan | ispos | isneg, 0.0, vals)
+        stacked = jnp.concatenate([
+            clean, isnan.astype(vals.dtype), ispos.astype(vals.dtype),
+            isneg.astype(vals.dtype)])
+        sums = backend(stacked, codes, mask, num_groups)
+        return ieee_reassemble(sums[:a], sums[a:2 * a], sums[2 * a:3 * a],
+                               sums[3 * a:])
+    return wrapped
 
 
 def reference_segmented_sums(vals, codes, mask, num_groups):

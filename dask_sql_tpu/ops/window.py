@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..table import Column, Scalar, Table
+from ..table import dict_sort_order, Column, Scalar, Table
 from ..types import SqlType, physical_dtype
 from .kernels import comparable_data, factorize_columns
 
@@ -289,7 +289,7 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
 
 
 def _ranks_to_string(rank_col: Column, orig: Column, stype: SqlType) -> Column:
-    order = np.argsort(orig.dictionary.astype(str), kind="stable")
+    order = dict_sort_order(orig.dictionary)
     inv = jnp.asarray(order.astype(np.int64))
     safe = jnp.clip(rank_col.data.astype(jnp.int64), 0, len(order) - 1)
     codes = jnp.take(inv, safe).astype(jnp.int32)

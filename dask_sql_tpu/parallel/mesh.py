@@ -40,6 +40,39 @@ def pad_to_multiple(n: int, k: int) -> int:
     return ((n + k - 1) // k) * k
 
 
+def shard_table_with_validity(table, mesh: Mesh):
+    """Mesh-mode catalog placement: pad rows to device-count divisibility,
+    row-shard every column, and return a row-validity mask (same sharding)
+    marking the real rows. Column NULL masks are untouched — padding
+    visibility is a TABLE property (COUNT(*) must not see pad rows), which
+    the compiled executor's validity-mask pipeline consumes directly
+    (physical/compiled.py _VT)."""
+    import jax.numpy as jnp
+
+    from ..table import Column, Table
+
+    n = table.num_rows
+    k = mesh.devices.size
+    padded = pad_to_multiple(max(n, 1), k)
+    sh = row_sharding(mesh)
+    pad = padded - n
+    cols = []
+    for c in table.columns:
+        data = c.data
+        mask = c.mask
+        if pad:
+            data = jnp.concatenate([data, jnp.zeros(pad, dtype=data.dtype)])
+            if mask is not None:
+                mask = jnp.concatenate([mask, jnp.zeros(pad, dtype=bool)])
+        data = jax.device_put(data, sh)
+        if mask is not None:
+            mask = jax.device_put(mask, sh)
+        cols.append(Column(data, c.stype, mask, c.dictionary))
+    row_valid = jax.device_put(
+        jnp.arange(padded) < n, sh) if pad else None
+    return Table(list(table.names), cols), row_valid
+
+
 def shard_table(table, mesh: Mesh):
     """Place every column row-sharded on the mesh (pads to divisibility).
 

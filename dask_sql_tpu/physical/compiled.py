@@ -40,7 +40,7 @@ from ..plan.nodes import (
     LogicalTableScan, LogicalUnion, LogicalValues, RelNode, RexCall,
     RexInputRef, RexLiteral, RexNode,
 )
-from ..table import Column, Scalar, Table
+from ..table import dict_sort_order, Column, Scalar, Table
 from .rex.evaluate import evaluate_predicate, evaluate_rex
 
 logger = logging.getLogger(__name__)
@@ -94,8 +94,10 @@ def _fp_plan(rel: RelNode, context, scans: list) -> str:
             raise Unsupported("view scan")
         if entry.table.num_rows == 0:
             raise Unsupported("empty table")
-        scans.append(((rel.schema_name, rel.table_name), entry.table))
-        return f"Scan({rel.schema_name}.{rel.table_name})[{schema}]"
+        scans.append(((rel.schema_name, rel.table_name), entry.table,
+                      entry.row_valid))
+        rv = "+rv" if entry.row_valid is not None else ""
+        return f"Scan({rel.schema_name}.{rel.table_name}{rv})[{schema}]"
     if isinstance(rel, LogicalProject):
         body = ",".join(_fp_rex(e) for e in rel.exprs)
     elif isinstance(rel, LogicalFilter):
@@ -132,14 +134,14 @@ def _fp_plan(rel: RelNode, context, scans: list) -> str:
 
 def _fp_inputs(scans: list) -> tuple:
     out = []
-    for _, tbl in scans:
+    for _, tbl, row_valid in scans:
         cols = tuple(
             (c.data.shape, str(c.data.dtype), c.mask is not None)
             for c in tbl.columns)
         # tbl.uid is monotonic and never reused (unlike id()), so a cache
         # hit implies the exact Table traced against — including the string
         # dictionaries embedded in the jitted program as constants
-        out.append((tbl.uid, cols))
+        out.append((tbl.uid, cols, row_valid is not None))
     return tuple(out)
 
 
@@ -247,36 +249,61 @@ def _group_sort(parts, invalid_row: jax.Array) -> jax.Array:
     return jnp.lexsort(arrays)
 
 
-def _traced_factorize(key_cols: List[Column], row_valid: Optional[jax.Array],
-                      cap: int):
-    """GROUP BY factorize inside a trace.
+class _GroupSorted:
+    """Group-sorted stream: the one factorize result both the aggregate and
+    UNION DISTINCT paths consume (scatter-free; see ops/sorted_agg.py)."""
 
-    Returns (codes[n] in [0..cap] where cap = trash slot for invalid rows and
-    group overflow, first_rows[cap], num_groups device scalar). Group order
-    matches the eager factorize (null-first, ascending per key).
+    __slots__ = ("perm", "valid_sorted", "codes_sorted", "num_groups",
+                 "starts", "ends", "first_rows", "n", "cap")
+
+
+def _group_sorted_codes(key_cols: List[Column],
+                        row_valid: Optional[jax.Array],
+                        cap: int) -> _GroupSorted:
+    """Sort rows into group order and derive dense codes in sorted space.
+
+    Group order matches the eager factorize (null-first, ascending per key);
+    invalid rows and groups beyond ``cap`` land in the trash slot ``cap``.
+    Stable sort makes ``first_rows[g]`` the group's first original row.
     """
+    from ..ops import sorted_agg as sa
+
     n = len(key_cols[0])
     parts = _key_parts(key_cols)
     invalid = jnp.zeros(n, dtype=bool) if row_valid is None else ~row_valid
     perm = _group_sort(parts, invalid)
 
     valid_sorted = ~invalid[perm]
-    boundary = jnp.zeros(n, dtype=bool).at[0].set(True)
-    for d, null in parts:
-        ds, ns = d[perm], null[perm]
-        diff = jnp.concatenate([jnp.ones(1, bool),
-                                (ds[1:] != ds[:-1]) | (ns[1:] != ns[:-1])])
-        boundary = boundary | diff
+    boundary = jnp.zeros(n, dtype=bool)
+    for d, flag in parts:
+        ds, fs = d[perm], flag[perm]
+        boundary = boundary | jnp.concatenate(
+            [jnp.ones(1, bool), (ds[1:] != ds[:-1]) | (fs[1:] != fs[:-1])])
     boundary = boundary & valid_sorted
     codes_sorted = jnp.cumsum(boundary.astype(jnp.int64)) - 1
     # last valid row's code + 1; if no valid rows, 0
-    num_groups = jnp.where(valid_sorted.any(),
-                           jnp.max(jnp.where(valid_sorted, codes_sorted, -1)) + 1,
-                           0)
+    num_groups = jnp.where(
+        valid_sorted.any(),
+        jnp.max(jnp.where(valid_sorted, codes_sorted, -1)) + 1, 0)
     codes_sorted = jnp.where(valid_sorted, jnp.minimum(codes_sorted, cap), cap)
-    codes = jnp.zeros(n, dtype=jnp.int64).at[perm].set(codes_sorted)
-    first = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int64), codes, cap + 1)[:cap]
-    return codes, first, num_groups
+
+    gs = _GroupSorted()
+    gs.perm, gs.valid_sorted, gs.codes_sorted = perm, valid_sorted, codes_sorted
+    gs.num_groups, gs.n, gs.cap = num_groups, n, cap
+    gs.starts, gs.ends = sa.segment_bounds(codes_sorted, cap)
+    gs.first_rows = perm[jnp.clip(gs.starts, 0, max(n - 1, 0))]
+    return gs
+
+
+def _traced_factorize(key_cols: List[Column], row_valid: Optional[jax.Array],
+                      cap: int):
+    """Original-row-order codes view of _group_sorted_codes (UNION DISTINCT
+    needs codes per input row; the inverse permutation is an argsort, not a
+    scatter)."""
+    gs = _group_sorted_codes(key_cols, row_valid, cap)
+    inv = jnp.argsort(gs.perm)
+    codes = gs.codes_sorted[inv]
+    return codes, gs.first_rows, gs.num_groups
 
 
 STATIC_DOMAIN_CAP = 4096
@@ -285,10 +312,13 @@ STATIC_DOMAIN_CAP = 4096
 def _try_static_codes(cols: List[Column]):
     """Direct group codes when every key has a statically-enumerable domain
     (dictionary-encoded strings, booleans). Returns (codes[n] int64 in
-    [0, domain), domain) or None. Code order == eager group order
-    (NULL slot first, then dictionary rank order)."""
+    [0, domain), domain, key_meta) or None; key_meta carries per-key
+    (size, nullable) so slots decode back to key values without touching
+    the data. Code order == eager group order (NULL slot first, then
+    dictionary rank order)."""
     domain = 1
     parts: List[Tuple[jax.Array, int]] = []
+    key_meta: List[Tuple[int, bool]] = []
     for c in cols:
         nullable = c.mask is not None
         if c.stype.is_string:
@@ -302,14 +332,42 @@ def _try_static_codes(cols: List[Column]):
         if nullable:
             code = jnp.where(c.mask, code + 1, 0)
             size += 1
-        domain *= max(size, 1)
+        size = max(size, 1)
+        domain *= size
         if domain > STATIC_DOMAIN_CAP:
             return None
         parts.append((code, size))
+        key_meta.append((size, nullable))
     combined = parts[0][0]
     for code, size in parts[1:]:
         combined = combined * size + code
-    return combined, domain
+    return combined, domain, key_meta
+
+
+def _decode_static_keys(cols: List[Column], key_meta, domain: int
+                        ) -> List[Column]:
+    """Group-key output columns straight from the slot index: slot g encodes
+    (rank+null) digits in mixed radix, so the key values are arithmetic on
+    ``arange(domain)`` plus a static rank->dictionary-code gather — the row
+    data is never touched."""
+    g = jnp.arange(domain, dtype=jnp.int64)
+    stride = domain
+    out: List[Column] = []
+    for c, (size, nullable) in zip(cols, key_meta):
+        stride //= size
+        code = (g // stride) % size
+        mask = None
+        if nullable:
+            mask = code != 0
+            code = jnp.maximum(code - 1, 0)
+        if c.stype.is_string:
+            # code is a sort RANK; order[rank] = dictionary index
+            order = dict_sort_order(c.dictionary)
+            data = jnp.take(jnp.asarray(order.astype(np.int32)), code)
+            out.append(Column(data, c.stype, mask, c.dictionary))
+        else:
+            out.append(Column(code.astype(jnp.bool_), c.stype, mask))
+    return out
 
 
 def _join_key_parts(lcols: List[Column], rcols: List[Column]):
@@ -384,11 +442,11 @@ class _Tracer:
 
     # -- nodes -------------------------------------------------------------
     def _LogicalTableScan(self, rel: LogicalTableScan) -> _VT:
-        t = self.scan_tables[(rel.schema_name, rel.table_name)]
+        t, valid = self.scan_tables[(rel.schema_name, rel.table_name)]
         want = [f.name for f in rel.schema]
         if t.names != want:
             t = t.limit_to(want)
-        return _VT(t, None)
+        return _VT(t, valid)
 
     def _LogicalProject(self, rel: LogicalProject) -> _VT:
         src = self.run(rel.input)
@@ -425,108 +483,124 @@ class _Tracer:
                 f = rel.schema[j]
                 col = src.table.columns[agg.args[0]] if agg.args else None
                 fmask = self._agg_filter(agg, src)
-                out_cols.append(G.segment_aggregate(
-                    agg.op, col, None, 1, f.stype, fmask, n))
+                out_cols.append(G.whole_table_aggregate(
+                    agg.op, col, fmask, f.stype, n))
             return _VT(Table(out_names, out_cols), None)
 
         key_cols = [src.table.columns[i] for i in rel.group_keys]
-        static = _try_static_codes(key_cols)
+        static = self._static_domain_aggregate(rel, src, key_cols)
         if static is not None:
-            return self._static_domain_aggregate(rel, src, static)
+            return static
 
+        # general path: group-sort once, then every aggregate is a prefix-sum
+        # difference or segmented scan over the sorted stream — no scatter
+        # (TPU scatter is serialized; see ops/sorted_agg.py)
         tag = f"agg{self._agg_counter}"
         self._agg_counter += 1
         cap = min(self.caps.get(tag, DEFAULT_GROUP_CAP), n)
-        codes, first, num_groups = _traced_factorize(key_cols, src.valid, cap)
-        self.ngroups.append(num_groups)
+        gs = _group_sorted_codes(key_cols, src.valid, cap)
+        self.ngroups.append(gs.num_groups)
         self.ngroup_caps.append(cap)
 
-        safe_first = jnp.clip(first, 0, n - 1)
-        for i, ki in enumerate(rel.group_keys):
-            out_cols.append(src.table.columns[ki].take(safe_first))
+        for ki in rel.group_keys:
+            out_cols.append(src.table.columns[ki].take(gs.first_rows))
+
+        sorted_cols: Dict[int, Column] = {}
+
+        def _sorted_col(idx: int) -> Column:
+            if idx not in sorted_cols:
+                sorted_cols[idx] = src.table.columns[idx].take(gs.perm)
+            return sorted_cols[idx]
+
         for j, agg in enumerate(rel.aggs):
             f = rel.schema[len(rel.group_keys) + j]
-            col = src.table.columns[agg.args[0]] if agg.args else None
-            fmask = self._agg_filter(agg, src)
-            out_cols.append(G.segment_aggregate(
-                agg.op, col, codes, cap + 1, f.stype, fmask, n).slice(0, cap))
-        row_valid = jnp.arange(cap) < num_groups
+            col_s = _sorted_col(agg.args[0]) if agg.args else None
+            vmask = gs.valid_sorted
+            if col_s is not None and col_s.mask is not None:
+                vmask = vmask & col_s.mask
+            if agg.filter_arg is not None:
+                fc = _sorted_col(agg.filter_arg)
+                vmask = vmask & fc.data.astype(bool) & fc.valid_mask()
+            out_cols.append(G.sorted_segment_aggregate(
+                agg.op, col_s, vmask, gs.codes_sorted, gs.starts, gs.ends,
+                f.stype))
+        row_valid = jnp.arange(cap) < gs.num_groups
         return _VT(Table(out_names, out_cols), row_valid)
 
-    def _static_domain_aggregate(self, rel, src: _VT, static) -> _VT:
+    def _static_domain_aggregate(self, rel, src: _VT, key_cols
+                                 ) -> Optional[_VT]:
         """GROUP BY over a statically-enumerable key domain (dict-encoded
         strings / booleans): codes come straight from dictionary ranks — no
-        sort, no capacity escalation — and the SUM/COUNT/AVG family reduces
-        via the MXU one-hot kernel (ops/pallas_kernels.py) on TPU.
+        sort, no scatter, no capacity escalation — and all reductions ride
+        the MXU one-hot kernel (ops/pallas_kernels.py) on TPU. Key output
+        columns are decoded from the slot index, so the data stream is
+        touched exactly once. Returns None when the shape doesn't fit
+        (non-MXU aggregates, non-enumerable keys, huge domains).
 
         This is the TPC-H Q1 shape: GROUP BY returnflag, linestatus.
         """
         from ..ops import pallas_kernels as pk
-        codes_raw, domain = static
+        static = _try_static_codes(key_cols)
+        if static is None:
+            return None
+        codes, domain, key_meta = static
+        if domain > 256:
+            return None
+        for agg in rel.aggs:
+            col = src.table.columns[agg.args[0]] if agg.args else None
+            if agg.op not in ("SUM", "$SUM0", "AVG", "COUNT"):
+                return None
+            if col is not None and not jnp.issubdtype(col.data.dtype,
+                                                      jnp.floating):
+                return None
+
         n = src.n
         rv = src.valid
-        codes = codes_raw if rv is None else jnp.where(rv, codes_raw, domain)
-        ones = jnp.ones(n, dtype=jnp.int64) if rv is None \
-            else rv.astype(jnp.int64)
-        occupancy = jax.ops.segment_sum(ones, codes, domain + 1)[:domain] > 0
-        first = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int64), codes,
-                                    domain + 1)[:domain]
-        safe_first = jnp.clip(first, 0, n - 1)
+        kmask = jnp.ones(n, bool) if rv is None else rv
 
         out_names = [f.name for f in rel.schema]
-        out_cols: List[Column] = [
-            src.table.columns[ki].take(safe_first) for ki in rel.group_keys]
+        out_cols: List[Column] = _decode_static_keys(key_cols, key_meta,
+                                                     domain)
 
-        # split aggregates: MXU-reducible (SUM family over floats) vs rest
-        mxu_rows, mxu_slots = [], []
-        use_pallas = pk._on_tpu() or os.environ.get("DSQL_PALLAS") == "force"
-        results: List[Optional[Column]] = [None] * len(rel.aggs)
+        mxu_rows = [kmask.astype(jnp.float64)]  # row 0: occupancy counts
+        slots = []
         for j, agg in enumerate(rel.aggs):
             f = rel.schema[len(rel.group_keys) + j]
             col = src.table.columns[agg.args[0]] if agg.args else None
             fmask = self._agg_filter(agg, src)
-            if (agg.op in ("SUM", "$SUM0", "AVG", "COUNT")
-                    and (col is None
-                         or jnp.issubdtype(col.data.dtype, jnp.floating))
-                    and domain <= 256):
-                if col is None:
-                    vmask = jnp.ones(n, bool) if fmask is None else fmask
-                    vrow = vmask.astype(jnp.float64)
-                    crow = vrow
-                else:
-                    vmask = col.valid_mask() if fmask is None \
-                        else (col.valid_mask() & fmask)
-                    vrow = jnp.where(vmask, col.data.astype(jnp.float64), 0.0)
-                    crow = vmask.astype(jnp.float64)
-                mxu_slots.append((j, agg, f, len(mxu_rows)))
-                mxu_rows.append(vrow)
-                mxu_rows.append(crow)
+            if col is None:
+                vmask = jnp.ones(n, bool) if fmask is None else fmask
+                vrow = vmask.astype(jnp.float64)
+                crow = vrow
             else:
-                results[j] = G.segment_aggregate(
-                    agg.op, col, codes, domain + 1, f.stype, fmask,
-                    n).slice(0, domain)
+                vmask = col.valid_mask() if fmask is None \
+                    else (col.valid_mask() & fmask)
+                vrow = jnp.where(vmask, col.data.astype(jnp.float64), 0.0)
+                crow = vmask.astype(jnp.float64)
+            slots.append((j, agg, f, len(mxu_rows)))
+            mxu_rows.append(vrow)
+            mxu_rows.append(crow)
 
-        if mxu_slots:
-            stack = jnp.stack(mxu_rows)
-            kmask = jnp.ones(n, bool) if rv is None else rv
-            reducer = pk.segmented_sums if use_pallas \
-                else pk.reference_segmented_sums
-            red = reducer(stack, codes, kmask, domain + 1)[:, :domain]
-            from ..types import physical_dtype
-            for j, agg, f, row0 in mxu_slots:
-                sums, counts = red[row0], red[row0 + 1]
-                has = counts > 0
-                if agg.op == "COUNT":
-                    results[j] = Column(counts.astype(jnp.int64), f.stype, None)
-                elif agg.op == "$SUM0":
-                    results[j] = Column(
-                        sums.astype(physical_dtype(f.stype)), f.stype, None)
-                elif agg.op == "SUM":
-                    results[j] = Column(
-                        sums.astype(physical_dtype(f.stype)), f.stype, has)
-                else:  # AVG
-                    results[j] = Column(sums / jnp.maximum(counts, 1.0),
-                                        f.stype, has)
+        stack = jnp.stack(mxu_rows)
+        red = pk.segmented_sums_dispatch(stack, codes, kmask, domain)
+        occupancy = red[0] > 0
+
+        from ..types import physical_dtype
+        results: List[Optional[Column]] = [None] * len(rel.aggs)
+        for j, agg, f, row0 in slots:
+            sums, counts = red[row0], red[row0 + 1]
+            has = counts > 0
+            if agg.op == "COUNT":
+                results[j] = Column(counts.astype(jnp.int64), f.stype, None)
+            elif agg.op == "$SUM0":
+                results[j] = Column(
+                    sums.astype(physical_dtype(f.stype)), f.stype, None)
+            elif agg.op == "SUM":
+                results[j] = Column(
+                    sums.astype(physical_dtype(f.stype)), f.stype, has)
+            else:  # AVG
+                results[j] = Column(sums / jnp.maximum(counts, 1.0),
+                                    f.stype, has)
         out_cols.extend(results)
         return _VT(Table(out_names, out_cols), occupancy)
 
@@ -745,33 +819,39 @@ def _bounded_put(d: OrderedDict, key, value):
 
 def _flatten_tables(scans) -> List[jax.Array]:
     flat: List[jax.Array] = []
-    for _, tbl in scans:
+    for _, tbl, row_valid in scans:
         for c in tbl.columns:
             flat.append(c.data)
             if c.mask is not None:
                 flat.append(c.mask)
+        if row_valid is not None:
+            flat.append(row_valid)
     return flat
 
 
 def _build(plan: RelNode, context, scans, caps: Dict[str, int], key):
     """Create the jitted program for this plan + input spec."""
     spec = []
-    for skey, tbl in scans:
+    for skey, tbl, row_valid in scans:
         spec.append((skey, [(c.stype, c.mask is not None, c.dictionary)
-                            for c in tbl.columns], tbl.names))
+                            for c in tbl.columns], tbl.names,
+                     row_valid is not None))
     meta: dict = {}
 
     def fn(*flat):
         i = 0
-        tables: Dict[tuple, Table] = {}
-        for skey, colspec, names in spec:
+        tables: Dict[tuple, Tuple[Table, Optional[jax.Array]]] = {}
+        for skey, colspec, names, has_valid in spec:
             cols = []
             for stype, has_mask, dictionary in colspec:
                 data = flat[i]; i2 = i + 1
                 mask = flat[i2] if has_mask else None
                 i = i2 + 1 if has_mask else i2
                 cols.append(Column(data, stype, mask, dictionary))
-            tables[skey] = Table(names, cols)
+            valid = None
+            if has_valid:
+                valid = flat[i]; i += 1
+            tables[skey] = (Table(names, cols), valid)
         tr = _Tracer(context, tables, caps)
         out = tr.run(plan)
         n = out.n
@@ -807,12 +887,12 @@ class _NeedsRecompile(Exception):
         self.caps = caps
 
 
-def _materialize(entry: _Compiled, outs) -> Table:
+SMALL_FETCH_BYTES = 8 << 20
+
+
+def _check_flags(entry: _Compiled, flags) -> None:
+    """Raise _NeedsRecompile on group-cap overflow; flags[0] => eager."""
     meta = entry.meta
-    flags = np.asarray(outs[0])
-    if flags[0]:
-        stats["fallbacks"] += 1
-        return None
     ngroups = flags[2:]
     new_caps = dict(entry.caps)
     grew = False
@@ -823,6 +903,50 @@ def _materialize(entry: _Compiled, outs) -> Table:
             grew = True
     if grew:
         raise _NeedsRecompile(new_caps)
+
+
+def _materialize(entry: _Compiled, outs) -> Table:
+    meta = entry.meta
+    total_bytes = sum(int(getattr(o, "nbytes", 0)) for o in outs)
+    if total_bytes <= SMALL_FETCH_BYTES:
+        # small result: ONE blocking transfer for flags + all outputs, then
+        # compact on host — over a remote TPU each extra sync is a full
+        # tunnel round trip, so two-phase (flags, then data) costs double
+        host = jax.device_get(list(outs))
+        flags = host[0]
+        if flags[0]:
+            stats["fallbacks"] += 1
+            return None
+        _check_flags(entry, flags)
+        count = int(flags[1])
+        sel = None
+        if meta["has_valid"]:
+            valid = host[-1]
+            if count < meta["n_out"]:
+                sel = np.nonzero(valid)[0]
+        idx = 1
+        cols: List[Column] = []
+        for stype, has_mask, dictionary in meta["cols"]:
+            dev_data, data = outs[idx], host[idx]; idx += 1
+            dev_mask = mask = None
+            if has_mask:
+                dev_mask, mask = outs[idx], host[idx]; idx += 1
+            if sel is not None:
+                # compaction changes the rows: host slices are authoritative
+                # and the device copy is rebuilt lazily on upload
+                data = data[sel]
+                mask = mask[sel] if mask is not None else None
+                dev_data = jnp.asarray(data)
+                dev_mask = None if mask is None else jnp.asarray(mask)
+            cols.append(Column(dev_data, stype, dev_mask, dictionary,
+                               host_cache=(data, mask)))
+        return Table(meta["names"], cols)
+
+    flags = np.asarray(outs[0])
+    if flags[0]:
+        stats["fallbacks"] += 1
+        return None
+    _check_flags(entry, flags)
     count = int(flags[1])
     idx = 1
     cols: List[Column] = []

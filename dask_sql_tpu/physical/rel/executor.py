@@ -56,6 +56,10 @@ def _table_scan(rel: LogicalTableScan, ex: RelExecutor) -> Table:
     entry = ex.context.schema[rel.schema_name].tables[rel.table_name]
     if entry.table is not None:
         t = entry.table
+        if entry.row_valid is not None:
+            # mesh-mode table: drop the divisibility padding rows (the
+            # compiled executor consumes the mask directly instead)
+            t = t.take(mask_to_indices(entry.row_valid))
     else:
         t = ex.execute(entry.plan)
     return t.limit_to([f.name for f in rel.schema]) if t.names != [f.name for f in rel.schema] else t

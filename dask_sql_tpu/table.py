@@ -72,7 +72,7 @@ NULL = Scalar(None, NULLTYPE)
 class Column:
     """One device column: jax data + optional validity mask + logical type."""
 
-    __slots__ = ("data", "mask", "stype", "dictionary")
+    __slots__ = ("data", "mask", "stype", "dictionary", "host_cache")
 
     def __init__(
         self,
@@ -80,11 +80,16 @@ class Column:
         stype: SqlType,
         mask: Optional[jax.Array] = None,
         dictionary: Optional[np.ndarray] = None,
+        host_cache: Optional[tuple] = None,
     ):
         self.data = data
         self.stype = stype
         self.mask = mask
         self.dictionary = dictionary
+        # (np_data, np_mask_or_None): set when a host copy already exists
+        # (e.g. the compiled executor's single-fetch materialization) so
+        # to_numpy/to_pandas skip the device round trip
+        self.host_cache = host_cache
         if stype.is_string and dictionary is None:
             raise ValueError("string columns require a dictionary")
 
@@ -217,7 +222,7 @@ class Column:
         host (dictionary is small) and gather on device.
         """
         assert self.stype.is_string
-        order = np.argsort(self.dictionary.astype(str), kind="stable")
+        order = dict_sort_order(self.dictionary)
         ranks = np.empty(len(order), dtype=np.int32)
         ranks[order] = np.arange(len(order), dtype=np.int32)
         data = jnp.take(jnp.asarray(ranks), jnp.clip(self.data, 0, len(ranks) - 1))
@@ -226,6 +231,10 @@ class Column:
     # -- host conversion ---------------------------------------------------
     def to_numpy(self) -> np.ndarray:
         """Host representation with rich types; nulls become None/NaN/NaT."""
+        if self.host_cache is not None:
+            hd, hm = self.host_cache
+            self = Column(hd, self.stype,
+                          None if hm is None else hm, self.dictionary)
         self = self._drop_allvalid_mask()
         n = self.stype.name
         if self.stype.is_string:
@@ -273,6 +282,15 @@ class Column:
 
     def __repr__(self):
         return f"Column({self.stype}, len={len(self)}, nulls={self.null_count()})"
+
+
+def dict_sort_order(dictionary: np.ndarray) -> np.ndarray:
+    """Dictionary indices in string sort order: order[rank] = dict index.
+
+    The single source of truth for string collation — group ordering,
+    MIN/MAX, and static-domain key decoding must all agree on it.
+    """
+    return np.argsort(dictionary.astype(str), kind="stable")
 
 
 def _as_mask(mask) -> Optional[jax.Array]:
@@ -381,15 +399,21 @@ class Table:
         import pandas as pd
 
         # fetch every device buffer in ONE transfer: per-column np.asarray
-        # would pay a tunnel round trip each over a remote TPU
+        # would pay a tunnel round trip each over a remote TPU; columns with
+        # a host cache (compiled-executor results) need no fetch at all
         buffers = []
         for col in self.columns:
+            if col.host_cache is not None:
+                continue
             buffers.append(col.data)
             if col.mask is not None:
                 buffers.append(col.mask)
-        fetched = iter(jax.device_get(buffers))
+        fetched = iter(jax.device_get(buffers) if buffers else [])
         data = {}
         for name, col in zip(self.names, self.columns):
+            if col.host_cache is not None:
+                data[name] = col.to_numpy()
+                continue
             host_data = next(fetched)
             host_mask = next(fetched) if col.mask is not None else None
             host_col = Column(host_data, col.stype, host_mask, col.dictionary)

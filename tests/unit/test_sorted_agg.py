@@ -1,0 +1,91 @@
+"""Scatter-free segmented aggregation vs jax.ops.segment_* oracles."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dask_sql_tpu.ops import sorted_agg as sa
+
+
+def _setup(n=500, g=17, null_frac=0.3, seed=3):
+    rng = np.random.RandomState(seed)
+    codes = np.sort(rng.randint(0, g, n))
+    values = rng.randn(n) * 10
+    valid = rng.rand(n) > null_frac
+    cs = jnp.asarray(codes)
+    starts, ends = sa.segment_bounds(cs, g)
+    return (jnp.asarray(values), jnp.asarray(valid), cs, starts, ends,
+            codes, values, valid, g)
+
+
+def test_seg_count_and_sum():
+    v, m, cs, starts, ends, codes, values, valid, g = _setup()
+    got_c = np.asarray(sa.seg_count(m, starts, ends))
+    got_s = np.asarray(sa.seg_sum(v, m, cs, starts, ends))
+    for i in range(g):
+        sel = (codes == i) & valid
+        assert got_c[i] == sel.sum()
+        np.testing.assert_allclose(got_s[i], values[sel].sum(), rtol=1e-12)
+
+
+def test_seg_sum_int():
+    codes = jnp.asarray([0, 0, 1, 2, 2, 2])
+    vals = jnp.asarray([1, 2, 3, 4, 5, 6], dtype=jnp.int64)
+    valid = jnp.asarray([True, True, False, True, True, True])
+    starts, ends = sa.segment_bounds(codes, 3)
+    got = np.asarray(sa.seg_sum(vals, valid, codes, starts, ends))
+    assert got.tolist() == [3, 0, 15]
+
+
+def test_seg_sum_nonfinite_isolated():
+    codes = jnp.asarray([0, 0, 1, 1, 2, 3, 3])
+    vals = jnp.asarray([np.nan, 1.0, 2.0, 3.0, np.inf, -np.inf, np.inf])
+    valid = jnp.ones(7, bool)
+    starts, ends = sa.segment_bounds(codes, 4)
+    got = np.asarray(sa.seg_sum(vals, valid, codes, starts, ends))
+    assert np.isnan(got[0])
+    assert got[1] == 5.0          # NaN in segment 0 must not leak here
+    assert got[2] == np.inf
+    assert np.isnan(got[3])       # +inf + -inf
+
+
+def test_seg_min_max():
+    v, m, cs, starts, ends, codes, values, valid, g = _setup(seed=5)
+    got_min = np.asarray(sa.seg_min(v, m, cs, ends))
+    got_max = np.asarray(sa.seg_max(v, m, cs, ends))
+    for i in range(g):
+        sel = (codes == i) & valid
+        if sel.any():
+            assert got_min[i] == values[sel].min()
+            assert got_max[i] == values[sel].max()
+
+
+def test_first_last_valid_pos():
+    codes = jnp.asarray([0, 0, 0, 1, 1, 2])
+    valid = jnp.asarray([False, True, True, False, False, True])
+    starts, ends = sa.segment_bounds(codes, 3)
+    first = np.asarray(sa.seg_first_valid_pos(valid, codes, ends))
+    last = np.asarray(sa.seg_last_valid_pos(valid, codes, ends))
+    assert first.tolist() == [1, 6, 5]   # segment 1 has no valid row -> n
+    assert last.tolist() == [2, -1, 5]
+
+
+def test_empty_trailing_segments():
+    codes = jnp.asarray([0, 0, 1])
+    vals = jnp.asarray([1.0, 2.0, 3.0])
+    valid = jnp.ones(3, bool)
+    starts, ends = sa.segment_bounds(codes, 5)
+    got = np.asarray(sa.seg_sum(vals, valid, codes, starts, ends))
+    assert got.tolist() == [3.0, 3.0, 0.0, 0.0, 0.0]
+
+
+def test_seg_sum_no_cross_group_cancellation():
+    """A huge-magnitude group must not destroy later groups' precision (a
+    global prefix sum would absorb small values into the big running total)."""
+    codes = jnp.asarray([0, 1, 1, 1, 1])
+    vals = jnp.asarray([1e18, 1.0, 1.0, 1.0, 1.0])
+    valid = jnp.ones(5, bool)
+    starts, ends = sa.segment_bounds(codes, 2)
+    got = np.asarray(sa.seg_sum(vals, valid, codes, starts, ends))
+    assert got[0] == 1e18
+    assert got[1] == 4.0
