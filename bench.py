@@ -96,6 +96,9 @@ def _pandas_q3(cu, od, li) -> float:
 
 def main():
     _ensure_usable_platform()
+    # NOTE: no persistent compilation cache here — AOT deserialization is
+    # not reliable on the tunneled TPU backend (FAILED_PRECONDITION at
+    # execution time); compiles happen in-process per run.
     from benchmarks.tpch import QUERIES, generate_tpch
     from dask_sql_tpu import Context
 

@@ -220,19 +220,27 @@ def _join(rel: LogicalJoin, ex: RelExecutor) -> Table:
     out_names = [f.name for f in rel.schema]
 
     if jt in ("SEMI", "ANTI"):
-        if not equi and residual:
-            raise NotImplementedError("Non-equi SEMI/ANTI join")
         null_aware = getattr(rel, "null_aware", False)
-        if equi:
-            lk = [k for k, _ in equi]
-            rk = [k for _, k in equi]
-        else:
-            lk = rk = []
+        if not equi and residual:
+            # correlated EXISTS with only non-equi predicates: pair expansion
+            li, ri = J.cross_join_pairs(left.num_rows, right.num_rows)
+            return _semi_anti_pairs(ex, left, right, li, ri, residual, jt)
         if not equi:
             # EXISTS: keep all if right non-empty
             if jt == "SEMI":
                 return left if right.num_rows else left.slice(0, 0)
             return left.slice(0, 0) if right.num_rows else left
+        lk = [k for k, _ in equi]
+        rk = [k for _, k in equi]
+        if residual:
+            # equi + residual (e.g. decorrelated EXISTS with an inequality):
+            # expand equi matches, apply residual, reduce to row existence
+            assert not null_aware
+            from ...ops.kernels import join_key_codes
+            lcodes, rcodes = join_key_codes([left.columns[i] for i in lk],
+                                            [right.columns[i] for i in rk])
+            li, ri, _counts = J._expand_matches(lcodes, rcodes)
+            return _semi_anti_pairs(ex, left, right, li, ri, residual, jt)
         out, _ = J.join_tables(left, right, lk, rk, jt, null_aware)
         return out
 
@@ -272,6 +280,25 @@ def _join(rel: LogicalJoin, ex: RelExecutor) -> Table:
     if jt == "INNER":
         return pairs.take(mask_to_indices(keep))
     return J.rejoin_outer(left, right, pairs, keep, li, ri, jt).with_names(out_names)
+
+
+def _semi_anti_pairs(ex, left: Table, right: Table, li, ri,
+                     residual, jt: str) -> Table:
+    """SEMI/ANTI with residual predicates: evaluate the condition over the
+    candidate (left, right) row pairs, then keep left rows with (SEMI) or
+    without (ANTI) any surviving match."""
+    lt, rt = left.take(li), right.take(ri)
+    pairs = Table(
+        [f"l{i}" for i in range(len(lt.names))]
+        + [f"r{i}" for i in range(len(rt.names))],
+        lt.columns + rt.columns)
+    keep = evaluate_predicate(_and_rex(residual), pairs, ex)
+    if isinstance(keep, bool):
+        keep = jnp.full(pairs.num_rows, keep)
+    matched = np.zeros(left.num_rows, dtype=bool)
+    matched[np.asarray(li)[np.asarray(keep)]] = True
+    want = matched if jt == "SEMI" else ~matched
+    return left.take(jnp.asarray(np.flatnonzero(want)))
 
 
 def _and_rex(rexes):

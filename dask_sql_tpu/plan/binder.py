@@ -25,7 +25,8 @@ from .nodes import (
     AggCall, Field, LogicalAggregate, LogicalExcept, LogicalFilter,
     LogicalIntersect, LogicalJoin, LogicalProject, LogicalSample, LogicalSort,
     LogicalTableScan, LogicalUnion, LogicalValues, LogicalWindow, RelNode,
-    RexCall, RexInputRef, RexLiteral, RexNode, RexScalarSubquery, RexUdf,
+    RexCall, RexInputRef, RexLiteral, RexNode, RexOuterRef,
+    RexScalarSubquery, RexUdf,
     SortCollation, WindowCall, rex_inputs, shift_rex,
 )
 
@@ -198,10 +199,13 @@ class Binder:
     """Binds one statement. ``catalog`` is a Context-like object exposing
     resolve_table(parts) and get_function(name)."""
 
-    def __init__(self, catalog, sql: str = ""):
+    def __init__(self, catalog, sql: str = "", outer_scope: Optional[Scope] = None):
         self.catalog = catalog
         self.sql = sql
         self.cte_stack: List[Dict[str, RelNode]] = [{}]
+        # enclosing query's scope for correlated subqueries: unresolved
+        # columns become RexOuterRef and are eliminated by decorrelation
+        self.outer_scope = outer_scope
 
     def error(self, msg: str, node: Optional[A.Node] = None):
         pos = getattr(node, "pos", (0, 0)) if node is not None else (0, 0)
@@ -467,6 +471,134 @@ class Binder:
             plan = LogicalFilter(input=plan, condition=cond, schema=list(plan.schema))
         return plan, scope
 
+    # --------------------------------------------------- correlated scalar
+    def _bind_correlated_scalar_cmp(self, plan: RelNode, scope: Scope,
+                                    op: str, other_ast: A.Expr,
+                                    sq: A.Subquery) -> Tuple[bool, RelNode]:
+        """Decorrelate ``expr <op> (SELECT agg(..) FROM .. WHERE k = outer.k)``
+        into an INNER join against the subquery aggregated BY the correlation
+        keys, plus a comparison filter (the classic rewrite; the reference
+        gets it from Calcite's SubQueryRemoveRule). Empty groups vanish from
+        the grouped aggregate, which matches NULL-compares-false semantics
+        for a WHERE conjunct."""
+        sub = Binder(self.catalog, self.sql, outer_scope=scope)
+        sub.cte_stack = self.cte_stack[:]
+        sub_plan = sub.bind_query(sq.query)
+        if not _plan_has_outer(sub_plan):
+            return False, plan  # uncorrelated: the eager-scalar path handles it
+        if len(sub_plan.schema) != 1:
+            self.error("Scalar subquery must return one column", sq)
+
+        # peel output projections above the aggregate (e.g. 0.2 * AVG(x))
+        projects: List[LogicalProject] = []
+        core = sub_plan
+        while isinstance(core, LogicalProject):
+            if any(_rex_has_outer(e) for e in core.exprs):
+                self.error("Unsupported correlated subquery "
+                           "(correlation outside WHERE)", sq)
+            projects.append(core)
+            core = core.input
+        if not isinstance(core, LogicalAggregate) or core.group_keys:
+            self.error("Unsupported correlated scalar subquery "
+                       "(expected a whole-table aggregate)", sq)
+
+        # walk through the agg-argument projection chain to the filter
+        chain: List[LogicalProject] = []
+        node = core.input
+        while isinstance(node, LogicalProject):
+            if any(_rex_has_outer(e) for e in node.exprs):
+                self.error("Unsupported correlated subquery "
+                           "(correlation outside WHERE)", sq)
+            chain.append(node)
+            node = node.input
+        node2, corr = _extract_correlated(node, self, sq)
+
+        pairs: List[Tuple[int, int, SqlType]] = []  # (outer idx, inner idx)
+        for cj in corr:
+            o = i = None
+            if (isinstance(cj, RexCall) and cj.op == "="
+                    and len(cj.operands) == 2):
+                a, b = cj.operands
+                if isinstance(a, RexInputRef) and isinstance(b, RexOuterRef):
+                    o, i = b, a
+                elif isinstance(a, RexOuterRef) and isinstance(b, RexInputRef):
+                    o, i = a, b
+            if o is None:
+                self.error("Unsupported correlated subquery predicate "
+                           "(only equality correlation)", sq)
+            pairs.append((o.index, i.index, i.stype))
+        if not pairs:
+            self.error("Unsupported correlated subquery", sq)
+        needed: List[int] = []
+        for _, ii, _t in pairs:
+            if ii not in needed:
+                needed.append(ii)
+
+        # thread the correlation keys up through the projection chain
+        cur: RelNode = node2
+        key_pos = list(needed)
+        for P in reversed(chain):
+            exprs = list(P.exprs) + [
+                RexInputRef(k, cur.schema[k].stype) for k in key_pos]
+            fields = list(P.schema) + [
+                Field(cur.schema[k].name, cur.schema[k].stype)
+                for k in key_pos]
+            base = len(P.exprs)
+            cur = LogicalProject(input=cur, exprs=exprs, schema=fields)
+            key_pos = [base + j for j in range(len(needed))]
+
+        key_fields = [Field(cur.schema[k].name, cur.schema[k].stype)
+                      for k in key_pos]
+        agg2 = LogicalAggregate(input=cur, group_keys=list(key_pos),
+                                aggs=core.aggs,
+                                schema=key_fields + list(core.schema))
+        sub2: RelNode = agg2
+        nk = len(key_pos)
+        for P in reversed(projects):
+            exprs = ([RexInputRef(j, f.stype)
+                      for j, f in enumerate(key_fields)]
+                     + [shift_rex(e, nk) for e in P.exprs])
+            sub2 = LogicalProject(input=sub2, exprs=exprs,
+                                  schema=key_fields + list(P.schema))
+
+        # COUNT-style aggregates are 0 over an empty set, not NULL: the
+        # INNER-join rewrite would silently drop the no-match groups, so
+        # those use a LEFT join + COALESCE(count, 0) — only sound when the
+        # count is the subquery's direct output
+        count_like = any(a.op in ("COUNT", "REGR_COUNT", "$SUM0")
+                         for a in core.aggs)
+        trivial_projects = all(
+            len(P.exprs) == 1 and isinstance(P.exprs[0], RexInputRef)
+            for P in projects)
+        if count_like and (not trivial_projects or len(core.aggs) != 1):
+            self.error("Unsupported correlated COUNT subquery shape", sq)
+
+        nl = len(plan.schema)
+        inner_of = {ii: pos for pos, ii in enumerate(needed)}
+        cond: Optional[RexNode] = None
+        for oi, ii, styp in pairs:
+            eq = RexCall("=", [
+                RexInputRef(oi, scope.entries[oi].stype),
+                RexInputRef(nl + inner_of[ii], styp)], BOOLEAN)
+            cond = eq if cond is None else RexCall("AND", [cond, eq], BOOLEAN)
+        joined = LogicalJoin(left=plan, right=sub2,
+                             join_type="LEFT" if count_like else "INNER",
+                             condition=cond,
+                             schema=list(plan.schema) + list(sub2.schema))
+        lhs = self.bind_expr(other_ast, scope)  # left columns keep positions
+        val: RexNode = RexInputRef(nl + nk, sub2.schema[-1].stype)
+        if count_like:
+            val = RexCall("COALESCE", [val, RexLiteral(0, val.stype)],
+                          val.stype)
+        cmp = RexCall(op, [lhs, val], BOOLEAN)
+        filt = LogicalFilter(input=joined, condition=cmp,
+                             schema=list(joined.schema))
+        out = LogicalProject(
+            input=filt,
+            exprs=[RexInputRef(i, f.stype) for i, f in enumerate(plan.schema)],
+            schema=list(plan.schema))
+        return True, out
+
     def _try_bind_subquery_conjunct(self, plan: RelNode, scope: Scope,
                                     c: A.Expr) -> Tuple[bool, RelNode]:
         negated = False
@@ -476,14 +608,36 @@ class Binder:
                 negated = True
                 inner = inner.args[0]
         if not isinstance(inner, A.Subquery):
+            # comparison against a correlated scalar-aggregate subquery:
+            # expr <op> (SELECT agg(...) WHERE inner_col = outer_col ...)
+            if (isinstance(inner, A.Call)
+                    and inner.op in ("=", "<", ">", "<=", ">=", "<>")
+                    and len(inner.args) == 2):
+                for side, other in ((0, 1), (1, 0)):
+                    sq = inner.args[side]
+                    if isinstance(sq, A.Subquery) and sq.kind == "scalar":
+                        handled, out = self._bind_correlated_scalar_cmp(
+                            plan, scope, inner.op if side == 1 else
+                            _flip_cmp(inner.op), inner.args[other], sq)
+                        if handled:
+                            return True, out
             return False, plan
         kind = inner.kind
         neg = negated != inner.negated
         if kind == "exists":
-            sub = Binder(self.catalog, self.sql)
+            sub = Binder(self.catalog, self.sql, outer_scope=scope)
             sub.cte_stack = self.cte_stack[:]
             sub_plan = sub.bind_query(inner.query)
             jt = "ANTI" if neg else "SEMI"
+            if _plan_has_outer(sub_plan):
+                # correlated EXISTS: the correlated conjuncts of the
+                # subquery's top filter become the SEMI/ANTI join condition
+                core, corr = _extract_correlated(sub_plan, self, inner)
+                nl = len(plan.schema)
+                cond = _corr_join_condition(corr, nl)
+                out = LogicalJoin(left=plan, right=core, join_type=jt,
+                                  condition=cond, schema=list(plan.schema))
+                return True, out
             out = LogicalJoin(left=plan, right=sub_plan, join_type=jt,
                               condition=RexLiteral(True, BOOLEAN),
                               schema=list(plan.schema))
@@ -805,6 +959,11 @@ class Binder:
         if isinstance(e, A.ColumnRef):
             idx = scope.resolve(e.parts)
             if idx is None:
+                if self.outer_scope is not None:
+                    oidx = self.outer_scope.resolve(e.parts)
+                    if oidx is not None:
+                        return RexOuterRef(oidx,
+                                           self.outer_scope.entries[oidx].stype)
                 self.error(f"Column '{'.'.join(e.parts)}' not found", e)
             return RexInputRef(idx, scope.entries[idx].stype)
         if isinstance(e, A.Star):
@@ -1214,3 +1373,95 @@ def _parse_daytime_interval(value: str, unit: str, to_unit: Optional[str]) -> in
             seconds = v
     ms = (((days * 24 + hours) * 60 + minutes) * 60 + seconds) * 1000
     return sign * int(ms)
+
+
+# ---------------------------------------------------------------------------
+# correlated-subquery plan surgery (used by Binder decorrelation above)
+# ---------------------------------------------------------------------------
+
+def _rex_has_outer(rex: RexNode) -> bool:
+    if isinstance(rex, RexOuterRef):
+        return True
+    if isinstance(rex, (RexCall, RexUdf)):
+        return any(_rex_has_outer(o) for o in rex.operands)
+    return False
+
+
+def _node_rexes(node: RelNode) -> List[RexNode]:
+    if isinstance(node, LogicalFilter):
+        return [node.condition]
+    if isinstance(node, LogicalProject):
+        return list(node.exprs)
+    if isinstance(node, LogicalJoin):
+        return [node.condition] if node.condition is not None else []
+    return []
+
+
+def _plan_has_outer(plan: RelNode) -> bool:
+    if any(_rex_has_outer(r) for r in _node_rexes(plan)):
+        return True
+    return any(_plan_has_outer(i) for i in plan.inputs)
+
+
+def _extract_correlated(plan: RelNode, binder: "Binder", node: A.Node):
+    """Split the correlated conjuncts out of the plan's top filter(s).
+
+    Returns (plan without the correlated conjuncts, [corr conjunct rex]).
+    Correlation anywhere deeper than the top filter stack (join conditions,
+    nested subplans, projections) is rejected — those shapes need general
+    unnesting, which this engine does not implement (reference: Calcite
+    handles them via CorrelationId plans)."""
+    from .optimizer import _and_all, _split_conjuncts as _split_rex
+
+    corr: List[RexNode] = []
+    core = plan
+    while isinstance(core, LogicalProject) and not any(
+            _rex_has_outer(e) for e in core.exprs):
+        # projections above the filter are irrelevant for EXISTS
+        core = core.input
+    while isinstance(core, LogicalFilter):
+        conjs = _split_rex(core.condition)
+        pure = [c for c in conjs if not _rex_has_outer(c)]
+        corr.extend(c for c in conjs if _rex_has_outer(c))
+        inp = core.input
+        if pure:
+            cond = _and_all(pure)
+            core = LogicalFilter(input=inp, condition=cond,
+                                 schema=list(inp.schema))
+            break
+        core = inp
+    if _plan_has_outer(core):
+        binder.error("Unsupported correlated subquery "
+                     "(correlation below the top-level WHERE)", node)
+    return core, corr
+
+
+def _corr_join_condition(corr: List[RexNode], nl: int) -> RexNode:
+    """Correlated conjuncts -> join condition: outer refs address the left
+    side verbatim, inner refs shift past it."""
+    def rewrite(r: RexNode) -> RexNode:
+        if isinstance(r, RexOuterRef):
+            return RexInputRef(r.index, r.stype)
+        if isinstance(r, RexInputRef):
+            return RexInputRef(r.index + nl, r.stype)
+        if isinstance(r, RexCall):
+            return RexCall(r.op, [rewrite(o) for o in r.operands],
+                           r.stype, r.info)
+        if isinstance(r, RexUdf):
+            return RexUdf(r.name, r.func, [rewrite(o) for o in r.operands],
+                          r.stype, r.row_udf)
+        return r
+
+    if not corr:
+        return RexLiteral(True, BOOLEAN)
+    out = rewrite(corr[0])
+    for c in corr[1:]:
+        out = RexCall("AND", [out, rewrite(c)], BOOLEAN)
+    return out
+
+
+_CMP_FLIP = {"=": "=", "<>": "<>", "<": ">", ">": "<", "<=": ">=", ">=": "<="}
+
+
+def _flip_cmp(op: str) -> str:
+    return _CMP_FLIP[op]

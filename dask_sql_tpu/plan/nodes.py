@@ -70,6 +70,21 @@ class RexScalarSubquery(RexNode):
 
 
 @dataclass
+class RexOuterRef(RexNode):
+    """Column of the enclosing query inside a correlated subquery.
+
+    Exists only transiently during binding: the binder's decorrelation
+    rewrites (EXISTS -> SEMI/ANTI join condition, scalar aggregate
+    comparison -> grouped-aggregate join) eliminate every occurrence; a
+    surviving one is a binder bug and has no executor."""
+    index: int = 0
+    stype: SqlType = None
+
+    def __repr__(self):
+        return f"$outer{self.index}"
+
+
+@dataclass
 class RexUdf(RexNode):
     """A registered python scalar UDF call (Context.register_function)."""
     name: str

@@ -187,6 +187,27 @@ def push_filters(rel: RelNode) -> RelNode:
                                      schema=rel.schema)
             return new_join
 
+    # -- through SEMI/ANTI joins: their output IS the left input, so pure
+    # conjuncts always push into the left side (without this, a WHERE above
+    # a decorrelated IN/EXISTS keeps whole cross products unfiltered)
+    if isinstance(child, LogicalJoin) and child.join_type in ("SEMI", "ANTI"):
+        pushable = [c for c in conjuncts if _is_pure(c)]
+        stay = [c for c in conjuncts if not _is_pure(c)]
+        if pushable:
+            new_left = push_filters(LogicalFilter(
+                input=child.left, condition=_and_all(pushable),
+                schema=child.left.schema))
+            new_join = LogicalJoin(left=new_left, right=child.right,
+                                   join_type=child.join_type,
+                                   condition=child.condition,
+                                   schema=child.schema)
+            if hasattr(child, "null_aware"):
+                new_join.null_aware = child.null_aware  # type: ignore
+            if stay:
+                return LogicalFilter(input=new_join, condition=_and_all(stay),
+                                     schema=rel.schema)
+            return new_join
+
     # -- through Aggregate: conjuncts that only touch group keys
     if isinstance(child, LogicalAggregate):
         n_keys = len(child.group_keys)
@@ -308,13 +329,17 @@ def _prune(rel: RelNode, needed: Set[int]) -> Tuple[RelNode, Dict[int, int]]:
         cond = remap_rex(rel.condition, mapping) if rel.condition is not None else None
         if rel.join_type in ("SEMI", "ANTI"):
             new_schema = [rel.schema[i] for i in sorted(lmap.keys())]
+            # the right side is not part of the output: returning its
+            # phantom ordinals would corrupt the parent's schema accounting
+            out_mapping = dict(lmap)
         else:
             new_schema = ([rel.schema[i] for i in sorted(lmap.keys())] +
                           [rel.schema[nl + i] for i in sorted(rmap.keys())])
+            out_mapping = mapping
         out = LogicalJoin(new_left, new_right, rel.join_type, cond, new_schema)
         if hasattr(rel, "null_aware"):
             out.null_aware = rel.null_aware  # type: ignore[attr-defined]
-        return out, mapping
+        return out, out_mapping
 
     if isinstance(rel, LogicalSort):
         child_needed = set(needed) | {c.index for c in rel.collation}
@@ -379,7 +404,62 @@ def _prune(rel: RelNode, needed: Set[int]) -> Tuple[RelNode, Dict[int, int]]:
 # driver
 # ---------------------------------------------------------------------------
 
-PASSES = [merge_filters, push_filters, merge_filters, merge_projects]
+def _factor_or(rex: RexNode) -> RexNode:
+    """Pull conjuncts common to every OR branch out of the OR:
+    (a AND x) OR (a AND y) -> a AND (x OR y).
+
+    Equivalent under SQL three-valued logic for predicate positions (both
+    forms are non-true in exactly the same cases). Without it, TPC-H Q19's
+    OR-of-conjuncts hides its shared equi-join key and the executor falls
+    back to a full cross product.
+    """
+    if not isinstance(rex, RexCall):
+        return rex
+    rex = RexCall(rex.op, [_factor_or(o) for o in rex.operands],
+                  rex.stype, rex.info)
+    if rex.op != "OR":
+        return rex
+
+    def branches(r: RexNode) -> List[RexNode]:
+        if isinstance(r, RexCall) and r.op == "OR":
+            return branches(r.operands[0]) + branches(r.operands[1])
+        return [r]
+
+    brs = [(_split_conjuncts(b)) for b in branches(rex)]
+    common = [c for c in brs[0]
+              if _is_pure(c) and all(any(c == d for d in b) for b in brs[1:])]
+    if not common:
+        return rex
+    rest_branches = []
+    for b in brs:
+        rest = [c for c in b if not any(c == d for d in common)]
+        rest_branches.append(_and_all(rest) or RexLiteral(True, BOOLEAN))
+    rest_or = rest_branches[0]
+    for rb in rest_branches[1:]:
+        rest_or = RexCall("OR", [rest_or, rb], BOOLEAN)
+    return _and_all(common + [rest_or])
+
+
+def factor_or_predicates(rel: RelNode) -> RelNode:
+    if rel.inputs:
+        rel = rel.with_inputs([factor_or_predicates(i) for i in rel.inputs])
+    if isinstance(rel, LogicalFilter):
+        return LogicalFilter(input=rel.input,
+                             condition=_factor_or(rel.condition),
+                             schema=rel.schema)
+    if isinstance(rel, LogicalJoin) and rel.condition is not None:
+        out = LogicalJoin(left=rel.left, right=rel.right,
+                          join_type=rel.join_type,
+                          condition=_factor_or(rel.condition),
+                          schema=rel.schema)
+        if hasattr(rel, "null_aware"):
+            out.null_aware = rel.null_aware  # type: ignore[attr-defined]
+        return out
+    return rel
+
+
+PASSES = [merge_filters, factor_or_predicates, push_filters, merge_filters,
+          merge_projects]
 
 
 def optimize(plan: RelNode, enable_pruning: bool = True) -> RelNode:

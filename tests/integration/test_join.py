@@ -154,3 +154,34 @@ def test_self_join(c, user_table_1):
            JOIN user_table_1 b ON a.user_id = b.user_id""").to_pandas()
     expected = user_table_1.merge(user_table_1, on="user_id")
     assert len(result) == len(expected)
+
+
+def test_correlated_count_zero_matches(c):
+    """WHERE 0 = (SELECT COUNT(*) ... correlated) must keep outer rows with
+    no matches: the decorrelation uses a LEFT join + COALESCE, not the
+    INNER-join rewrite that silently drops empty groups."""
+    import pandas as pd
+    c.create_table("cc_l", pd.DataFrame({"k": [1, 2, 3]}))
+    c.create_table("cc_r", pd.DataFrame({"k": [1, 1, 3]}))
+    r = c.sql("SELECT k FROM cc_l WHERE 0 = "
+              "(SELECT COUNT(*) FROM cc_r WHERE cc_r.k = cc_l.k)",
+              return_futures=False)
+    assert r["k"].tolist() == [2]
+    r2 = c.sql("SELECT k FROM cc_l WHERE 2 = "
+               "(SELECT COUNT(*) FROM cc_r WHERE cc_r.k = cc_l.k)",
+               return_futures=False)
+    assert r2["k"].tolist() == [1]
+
+
+def test_correlated_exists_and_scalar(c):
+    import pandas as pd
+    c.create_table("ce_o", pd.DataFrame({"k": [1, 2, 3], "v": [10.0, 20.0, 30.0]}))
+    c.create_table("ce_i", pd.DataFrame({"k": [1, 1, 2], "w": [5.0, 25.0, 10.0]}))
+    r = c.sql("SELECT k FROM ce_o WHERE EXISTS "
+              "(SELECT * FROM ce_i WHERE ce_i.k = ce_o.k AND w > 6)",
+              return_futures=False)
+    assert sorted(r["k"].tolist()) == [1, 2]
+    r2 = c.sql("SELECT k FROM ce_o WHERE v > "
+               "(SELECT AVG(w) FROM ce_i WHERE ce_i.k = ce_o.k)",
+               return_futures=False)
+    assert sorted(r2["k"].tolist()) == [2]
