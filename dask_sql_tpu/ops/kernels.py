@@ -275,3 +275,66 @@ def trunc_date(unit: str, days: jax.Array, tod_us: Optional[jax.Array]):
     if u == "MILLISECOND":
         return days, (tod_us // 1000) * 1000
     raise NotImplementedError(f"FLOOR unit {unit}")
+
+
+# ---------------------------------------------------------------------------
+# trace-safe total-order keys (shared by the compiled executor and windows):
+# no 64-bit bitcasts (the TPU X64 rewrite lacks them); floats stay raw f64
+# with NULL/NaN class flags
+# ---------------------------------------------------------------------------
+
+_INT64_MIN = jnp.int64(-(2**63))
+
+
+def float_class(x: jax.Array, null: Optional[jax.Array]) -> jax.Array:
+    """0 = NULL (first), 1 = ordinary value, 2 = NaN (last)."""
+    cls = jnp.where(jnp.isnan(x), jnp.int8(2), jnp.int8(1))
+    if null is not None:
+        cls = jnp.where(null, jnp.int8(0), cls)
+    return cls
+
+
+def canon_f64(x: jax.Array) -> jax.Array:
+    """Canonical f64 sort/equality key: -0.0 -> +0.0, NaN -> 0 (class flag
+    disambiguates). No i64 bitcast — the TPU X64 rewrite can't do it."""
+    x = x.astype(jnp.float64) + 0.0
+    return jnp.where(jnp.isnan(x), 0.0, x)
+
+
+
+
+def orderable_int64(x: jax.Array) -> jax.Array:
+    """int64 key for non-float comparable data (ints, bools, dict ranks,
+    dates) — comparable_data already made the order numeric."""
+    return x.astype(jnp.int64)
+
+
+def key_parts(cols: List[Column]) -> List[Tuple[jax.Array, jax.Array]]:
+    """(data, class flag) per key column for grouping/dedup.
+
+    data is canonical f64 for float columns (no 64-bit bitcast on TPU) or
+    int64 with a NULL sentinel otherwise; the int8 class flag orders
+    NULL(0) < values(1) < NaN(2) and disambiguates sentinel collisions.
+    Equality of (data, flag) == SQL group equality (-0.0 == +0.0,
+    NaNs grouped together, NULLs grouped together).
+    """
+    out = []
+    for c in cols:
+        raw = comparable_data(c)
+        null = (~c.mask) if c.mask is not None else None
+        if jnp.issubdtype(raw.dtype, jnp.floating):
+            d = canon_f64(raw)
+            flag = float_class(raw, null)
+            if null is not None:
+                d = jnp.where(null, 0.0, d)
+        else:
+            d = orderable_int64(raw)
+            if null is not None:
+                d = jnp.where(null, _INT64_MIN, d)
+                flag = jnp.where(null, jnp.int8(0), jnp.int8(1))
+            else:
+                flag = jnp.ones(d.shape[0], dtype=jnp.int8)
+        out.append((d, flag))
+    return out
+
+

@@ -3,8 +3,14 @@
 The reference collapses each PARTITION BY group to a single pandas partition
 via groupby().apply (/root/reference/dask_sql/physical/rel/logical/
 window.py:152-205) — a scalability cliff SURVEY §5 calls out.  Here windows
-are computed as sorted segmented scans: factorize partitions, lexsort by
-(partition, order keys), run prefix-scan kernels, scatter back to row order.
+are computed as sorted segmented scans: lexsort by (partition, order keys),
+run prefix-scan kernels, gather back to row order.
+
+Everything on the main path is jit-trace-safe (no host syncs, static
+shapes, no scatters): the compiled whole-plan executor
+(physical/compiled.py) calls ``compute_window`` directly inside its trace;
+only NTILE/LAG/LEAD/NTH_VALUE read their constant arguments from column
+data on the host and stay eager-only.
 """
 from __future__ import annotations
 
@@ -16,7 +22,15 @@ import numpy as np
 
 from ..table import dict_sort_order, Column, Scalar, Table
 from ..types import SqlType, physical_dtype
-from .kernels import comparable_data, factorize_columns
+from .kernels import comparable_data, key_parts
+
+# window ops whose kernels are fully trace-safe (the compiled executor's
+# supported subset; the rest read host constants)
+TRACE_SAFE_OPS = frozenset({
+    "ROW_NUMBER", "RANK", "DENSE_RANK", "PERCENT_RANK", "CUME_DIST",
+    "COUNT", "SUM", "$SUM0", "AVG", "MIN", "MAX",
+    "FIRST_VALUE", "LAST_VALUE", "SINGLE_VALUE",
+})
 
 
 def _segment_starts(codes_sorted: jax.Array) -> jax.Array:
@@ -33,12 +47,9 @@ def _segment_ids(starts: jax.Array) -> jax.Array:
 
 
 def segmented_cumsum(x: jax.Array, starts: jax.Array) -> jax.Array:
-    """Inclusive prefix sum that resets at segment starts."""
-    total = jnp.cumsum(x)
-    seg = _segment_ids(starts)
-    start_pos = jnp.nonzero(starts, size=int(starts.sum()))[0]
-    base = jnp.where(start_pos > 0, total[jnp.maximum(start_pos - 1, 0)], 0)
-    return total - base[seg]
+    """Inclusive prefix sum that resets at segment starts (trace-safe:
+    log-depth segmented scan, no data-dependent shapes)."""
+    return segmented_scan(x, starts, jnp.add)
 
 
 def segmented_scan(x: jax.Array, starts: jax.Array, combine) -> jax.Array:
@@ -54,19 +65,19 @@ def segmented_scan(x: jax.Array, starts: jax.Array, combine) -> jax.Array:
     return out
 
 
-def window_frame_sums(x: jax.Array, starts: jax.Array, seg: jax.Array,
-                      seg_start_pos: jax.Array, seg_end_pos: jax.Array,
+def window_frame_sums(x: jax.Array, seg_start: jax.Array, seg_end: jax.Array,
                       lo: Optional[int], hi: Optional[int]):
     """Moving SUM/COUNT over ROWS frames using prefix sums.
 
     lo/hi are row offsets relative to current (negative = preceding); None =
-    unbounded on that side.  All positions are within-sorted-order.
+    unbounded on that side. seg_start/seg_end are PER-ROW positions of the
+    row's segment bounds in sorted order.
     """
     n = x.shape[0]
     prefix = jnp.cumsum(x)
     idx = jnp.arange(n)
-    start = seg_start_pos[seg] if lo is None else jnp.maximum(idx + lo, seg_start_pos[seg])
-    end = seg_end_pos[seg] if hi is None else jnp.minimum(idx + hi, seg_end_pos[seg])
+    start = seg_start if lo is None else jnp.maximum(idx + lo, seg_start)
+    end = seg_end if hi is None else jnp.minimum(idx + hi, seg_end)
     end = jnp.minimum(end, n - 1)
     start = jnp.maximum(start, 0)
     upper = prefix[end]
@@ -78,20 +89,20 @@ def window_frame_sums(x: jax.Array, starts: jax.Array, seg: jax.Array,
 def compute_window(table: Table, op: str, arg_cols: List[int],
                    partition_cols: List[int],
                    order_keys: List[Tuple[int, bool, bool]],
-                   frame, stype: SqlType) -> Column:
-    """Compute one window call; returns a column aligned with table rows."""
+                   frame, stype: SqlType,
+                   row_valid: Optional[jax.Array] = None) -> Column:
+    """Compute one window call; returns a column aligned with table rows.
+
+    ``row_valid`` (compiled-executor mode): invalid/padding rows sort into
+    their own trailing segment so they never contaminate real partitions;
+    their outputs are garbage and must be masked by the caller's validity.
+    """
     n = table.num_rows
     if n == 0:
         return Column(jnp.zeros(0, dtype=physical_dtype(stype)), stype)
 
-    # 1. partition codes
-    if partition_cols:
-        codes, _, G = factorize_columns([table.columns[i] for i in partition_cols])
-    else:
-        codes = jnp.zeros(n, dtype=jnp.int64)
-        G = 1
-
-    # 2. sort by (partition, order keys)
+    # 1. sort by (validity, partition, order keys) — trace-safe: partitions
+    # come from key-part comparisons, not a factorize
     arrays = []
     for idx, asc, nulls_first in reversed(order_keys):
         col = table.columns[idx]
@@ -106,20 +117,34 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
             arrays.append(nullkey if not nulls_first else -nullkey)
         else:
             arrays.append(data)
-    arrays.append(codes)
-    perm = jnp.lexsort(arrays)
-    inv_perm = jnp.zeros(n, dtype=jnp.int64).at[perm].set(jnp.arange(n))
+    part_parts = key_parts([table.columns[i] for i in partition_cols]) \
+        if partition_cols else []
+    for d, flag in part_parts:
+        arrays.append(d)
+        arrays.append(flag)
+    if row_valid is not None:
+        arrays.append((~row_valid).astype(jnp.int8))  # invalid rows last
+    perm = jnp.lexsort(arrays) if arrays else jnp.arange(n)
+    inv_perm = jnp.argsort(perm)  # scatter-free inverse
 
-    scodes = codes[perm]
-    starts = _segment_starts(scodes)
-    seg = _segment_ids(starts)
-    nseg = int(scodes[-1] >= 0) and int(seg[-1]) + 1 if n else 0
-    nseg = int(seg[-1]) + 1 if n else 0
+    # 2. segment starts from sorted partition-part diffs (+ validity edge)
+    starts = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for d, flag in part_parts:
+        ds, fs = d[perm], flag[perm]
+        starts = starts | jnp.concatenate(
+            [jnp.ones(1, bool), (ds[1:] != ds[:-1]) | (fs[1:] != fs[:-1])])
+    if row_valid is not None:
+        vs = row_valid[perm]
+        starts = starts | jnp.concatenate(
+            [jnp.ones(1, bool), vs[1:] != vs[:-1]])
     pos = jnp.arange(n)
-    seg_start_pos = jnp.zeros(nseg, dtype=jnp.int64).at[seg].min(pos) if n else jnp.zeros(0, jnp.int64)
-    seg_start_pos = jnp.full(nseg, n, dtype=jnp.int64).at[seg].min(pos)
-    seg_end_pos = jnp.zeros(nseg, dtype=jnp.int64).at[seg].max(pos)
-    row_in_seg = pos - seg_start_pos[seg]
+    # per-row segment bounds via forward/backward segmented scans
+    seg_start = segmented_scan(pos, starts, jnp.minimum)
+    # reversed-stream segment starts: original row i is last-of-segment iff
+    # i == n-1 or starts[i+1]; flipping that gives the reverse-scan flags
+    ends_flags = jnp.concatenate([jnp.ones(1, bool), jnp.flip(starts[1:])])
+    seg_end = jnp.flip(segmented_scan(jnp.flip(pos), ends_flags, jnp.maximum))
+    row_in_seg = pos - seg_start
 
     # frame bounds as offsets
     lo_off, hi_off = _frame_offsets(op, frame, bool(order_keys))
@@ -135,32 +160,30 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
 
     if op in ("RANK", "DENSE_RANK", "PERCENT_RANK", "CUME_DIST"):
         tie = _tie_starts(table, order_keys, perm, starts)
-        # rank: position of first row of the tie-group
-        tie_group_start = segmented_scan(
-            jnp.where(tie, pos, 0), starts | tie, jnp.maximum)
-        # propagate last tie start within segment
+        # rank = position of the first row of the current tie group:
+        # propagate the last tie/segment start forward within the segment
         tie_start = segmented_scan(jnp.where(tie | starts, pos, -1), starts,
                                    jnp.maximum)
-        rank = tie_start - seg_start_pos[seg] + 1
+        rank = tie_start - seg_start + 1
         if op == "RANK":
             return scatter_back(rank)
         if op == "PERCENT_RANK":
-            seg_len = seg_end_pos[seg] - seg_start_pos[seg] + 1
+            seg_len = seg_end - seg_start + 1
             pr = jnp.where(seg_len > 1, (rank - 1) / jnp.maximum(seg_len - 1, 1), 0.0)
             return scatter_back(pr)
         if op == "CUME_DIST":
-            seg_len = seg_end_pos[seg] - seg_start_pos[seg] + 1
+            seg_len = seg_end - seg_start + 1
             # number of rows with order key <= current = end of tie group
             is_last_of_tie = jnp.concatenate([tie[1:] | starts[1:], jnp.ones(1, bool)])
-            tie_end = _backward_fill_positions(pos, is_last_of_tie, seg, seg_end_pos)
-            return scatter_back((tie_end - seg_start_pos[seg] + 1) / seg_len)
+            tie_end = _backward_fill_positions(pos, is_last_of_tie, seg_end)
+            return scatter_back((tie_end - seg_start + 1) / seg_len)
         # DENSE_RANK: count of tie-group starts up to here within segment
         dr = segmented_cumsum((tie | starts).astype(jnp.int64), starts)
         return scatter_back(dr)
 
     if op == "NTILE":
         k = int(np.asarray(table.columns[arg_cols[0]].data)[0]) if arg_cols else 1
-        seg_len = seg_end_pos[seg] - seg_start_pos[seg] + 1
+        seg_len = seg_end - seg_start + 1
         out = (row_in_seg * k) // jnp.maximum(seg_len, 1) + 1
         return scatter_back(out)
 
@@ -171,12 +194,12 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
             offset = int(np.asarray(table.columns[arg_cols[1]].data)[0])
         shift = -offset if op == "LAG" else offset
         src = pos + shift
-        valid = (src >= seg_start_pos[seg]) & (src <= seg_end_pos[seg])
+        valid = (src >= seg_start) & (src <= seg_end)
         src = jnp.clip(src, 0, n - 1)
         sorted_col = col.take(perm)
         gathered = sorted_col.take(src)
         m = gathered.valid_mask() & valid
-        out = scatter_back(gathered.data, None if bool(m.all()) else m)
+        out = scatter_back(gathered.data, m)
         if col.stype.is_string:
             return Column(out.data.astype(jnp.int32), stype, out.mask, col.dictionary)
         return out
@@ -184,17 +207,17 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
     if op in ("FIRST_VALUE", "LAST_VALUE", "NTH_VALUE"):
         col = table.columns[arg_cols[0]].take(perm)
         if op == "FIRST_VALUE":
-            src = seg_start_pos[seg]
+            src = seg_start
         elif op == "LAST_VALUE":
             # default frame = up to CURRENT ROW when ORDER BY present
             if order_keys and frame is None:
                 src = pos
             else:
-                src = seg_end_pos[seg]
+                src = seg_end
         else:
             k = int(np.asarray(table.columns[arg_cols[1]].data)[0])
-            src = seg_start_pos[seg] + (k - 1)
-            src = jnp.minimum(src, seg_end_pos[seg])
+            src = seg_start + (k - 1)
+            src = jnp.minimum(src, seg_end)
         gathered = col.take(src)
         out = scatter_back(gathered.data,
                            gathered.mask if gathered.mask is not None else None)
@@ -209,8 +232,7 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
             x = col.valid_mask().astype(jnp.int64)
         else:
             x = jnp.ones(n, dtype=jnp.int64)
-        out = window_frame_sums(x, starts, seg, seg_start_pos, seg_end_pos,
-                                lo_off, hi_off)
+        out = window_frame_sums(x, seg_start, seg_end, lo_off, hi_off)
         return scatter_back(out)
 
     if op in ("SUM", "$SUM0", "AVG"):
@@ -221,16 +243,15 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
             data = data.astype(jnp.int64)
         else:
             data = data.astype(jnp.float64)
-        s = window_frame_sums(data, starts, seg, seg_start_pos, seg_end_pos,
+        s = window_frame_sums(data, seg_start, seg_end, lo_off, hi_off)
+        c = window_frame_sums(valid.astype(jnp.int64), seg_start, seg_end,
                               lo_off, hi_off)
-        c = window_frame_sums(valid.astype(jnp.int64), starts, seg,
-                              seg_start_pos, seg_end_pos, lo_off, hi_off)
         if op == "AVG":
             out = s / jnp.maximum(c, 1)
             return scatter_back(out, (c > 0))
         if op == "$SUM0":
             return scatter_back(s)
-        return scatter_back(s, None if bool((c > 0).all()) else (c > 0))
+        return scatter_back(s, (c > 0))
 
     if op in ("MIN", "MAX"):
         col = table.columns[arg_cols[0]].take(perm)
@@ -248,8 +269,8 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
             out = segmented_scan(x, starts, combine)
         elif lo_off is None and hi_off is None:
             # whole partition: segment reduce then broadcast
-            total = jax.ops.segment_min(x, seg, nseg) if op == "MIN" else jax.ops.segment_max(x, seg, nseg)
-            out = total[seg]
+            total = segmented_scan(x, starts, combine)
+            out = total[seg_end]
         else:
             # bounded frame: windowed via per-offset shifts (frame sizes are
             # small constants in practice)
@@ -260,25 +281,25 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
                 if d == 0:
                     continue
                 src = jnp.clip(pos + d, 0, n - 1)
-                ok = (pos + d >= seg_start_pos[seg]) & (pos + d <= seg_end_pos[seg])
+                ok = (pos + d >= seg_start) & (pos + d <= seg_end)
                 out = combine(out, jnp.where(ok, x[src], sentinel))
-            in_frame_cnt = window_frame_sums(valid.astype(jnp.int64), starts, seg,
-                                             seg_start_pos, seg_end_pos, lo_off, hi_off)
+            in_frame_cnt = window_frame_sums(valid.astype(jnp.int64),
+                                             seg_start, seg_end, lo_off, hi_off)
             m = in_frame_cnt > 0
             if col.stype.is_string:
                 return _ranks_to_string(scatter_back(out, m), table.columns[arg_cols[0]], stype)
-            return scatter_back(out, None if bool(m.all()) else m)
-        c = window_frame_sums(valid.astype(jnp.int64), starts, seg,
-                              seg_start_pos, seg_end_pos, lo_off, hi_off)
+            return scatter_back(out, m)
+        c = window_frame_sums(valid.astype(jnp.int64), seg_start, seg_end,
+                              lo_off, hi_off)
         m = c > 0
         if col.stype.is_string:
-            return _ranks_to_string(scatter_back(out, None if bool(m.all()) else m),
+            return _ranks_to_string(scatter_back(out, m),
                                     table.columns[arg_cols[0]], stype)
-        return scatter_back(out, None if bool(m.all()) else m)
+        return scatter_back(out, m)
 
     if op == "SINGLE_VALUE":
         col = table.columns[arg_cols[0]].take(perm)
-        src = seg_start_pos[seg]
+        src = seg_start
         g = col.take(src)
         out = scatter_back(g.data, g.mask)
         if col.stype.is_string:
@@ -339,7 +360,7 @@ def _tie_starts(table: Table, order_keys, perm, starts) -> jax.Array:
     return diff & ~starts
 
 
-def _backward_fill_positions(pos, is_last, seg, seg_end_pos):
+def _backward_fill_positions(pos, is_last, seg_end):
     """For each row, position of the last row of its tie group."""
     n = pos.shape[0]
     # reverse scan: propagate next is_last position backwards
@@ -348,4 +369,4 @@ def _backward_fill_positions(pos, is_last, seg, seg_end_pos):
         lambda a, b: jnp.where(b >= 0, b, a), rev)
     # associative_scan is forward; combined op keeps latest valid
     filled = jnp.flip(rev_filled)
-    return jnp.where(filled >= 0, filled, seg_end_pos[seg])
+    return jnp.where(filled >= 0, filled, seg_end)

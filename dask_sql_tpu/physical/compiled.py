@@ -34,11 +34,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import groupby as G
-from ..ops.kernels import comparable_data, unify_string_codes
+from ..ops.kernels import (canon_f64, comparable_data, float_class,
+                           key_parts as _key_parts, orderable_int64,
+                           unify_string_codes)
 from ..plan.nodes import (
     LogicalAggregate, LogicalFilter, LogicalJoin, LogicalProject, LogicalSort,
-    LogicalTableScan, LogicalUnion, LogicalValues, RelNode, RexCall,
-    RexInputRef, RexLiteral, RexNode,
+    LogicalTableScan, LogicalUnion, LogicalValues, LogicalWindow, RelNode,
+    RexCall, RexInputRef, RexLiteral, RexNode,
 )
 from ..table import dict_sort_order, Column, Scalar, Table
 from .rex.evaluate import evaluate_predicate, evaluate_rex
@@ -122,6 +124,17 @@ def _fp_plan(rel: RelNode, context, scans: list) -> str:
                          f"{'nf' if c.effective_nulls_first else 'nl'}"
                          for c in rel.collation)
                 + f"|o={rel.offset}|l={rel.limit}")
+    elif isinstance(rel, LogicalWindow):
+        from ..ops.window import TRACE_SAFE_OPS
+        for call in rel.calls:
+            if call.op not in TRACE_SAFE_OPS:
+                raise Unsupported(f"window op {call.op}")
+        body = ";".join(
+            f"{call.op}({call.args})p{call.partition}"
+            + "o" + ",".join(f"{c.index}{'a' if c.ascending else 'd'}"
+                             f"{'nf' if c.effective_nulls_first else 'nl'}"
+                             for c in call.order)
+            + f"f{call.frame!r}" for call in rel.calls)
     elif isinstance(rel, LogicalUnion):
         body = f"all={rel.all}"
     elif isinstance(rel, LogicalValues):
@@ -149,39 +162,18 @@ def _fp_inputs(scans: list) -> tuple:
 # in-trace kernels
 # ---------------------------------------------------------------------------
 
-def _float_class(x: jax.Array, null: Optional[jax.Array]) -> jax.Array:
-    """0 = NULL (first), 1 = ordinary value, 2 = NaN (last)."""
-    cls = jnp.where(jnp.isnan(x), jnp.int8(2), jnp.int8(1))
-    if null is not None:
-        cls = jnp.where(null, jnp.int8(0), cls)
-    return cls
-
-
-def _canon_f64(x: jax.Array) -> jax.Array:
-    """Canonical f64 sort/equality key: -0.0 -> +0.0, NaN -> 0 (class flag
-    disambiguates). No i64 bitcast — the TPU X64 rewrite can't do it."""
-    x = x.astype(jnp.float64) + 0.0
-    return jnp.where(jnp.isnan(x), 0.0, x)
-
-
 def _f64_hash_part(x: jax.Array) -> jax.Array:
     """Deterministic u64 encoding of f64 for hashing without a 64-bit
     bitcast: double-float (hi, lo) f32 split, each bitcast to i32 (supported
     on TPU). ~48 mantissa bits — lossy encodings only add hash collisions,
     which the join's collision flag catches; equality is verified on raw
     values."""
-    x = _canon_f64(x)
+    x = canon_f64(x)
     hi = x.astype(jnp.float32)
     lo = (x - hi.astype(jnp.float64)).astype(jnp.float32)
     hi_b = jax.lax.bitcast_convert_type(hi, jnp.int32).astype(jnp.uint64)
     lo_b = jax.lax.bitcast_convert_type(lo, jnp.int32).astype(jnp.uint64)
     return (hi_b << np.uint64(32)) | (lo_b & np.uint64(0xFFFFFFFF))
-
-
-def _orderable_int64(x: jax.Array) -> jax.Array:
-    """int64 key for non-float comparable data (ints, bools, dict ranks,
-    dates) — comparable_data already made the order numeric."""
-    return x.astype(jnp.int64)
 
 
 def _mix64(z: jax.Array) -> jax.Array:
@@ -207,35 +199,6 @@ class _VT:
         if self.valid is None:
             return jnp.ones(self.n, dtype=bool)
         return self.valid
-
-
-def _key_parts(cols: List[Column]) -> List[Tuple[jax.Array, jax.Array]]:
-    """(data, class flag) per key column for grouping/dedup.
-
-    data is canonical f64 for float columns (no 64-bit bitcast on TPU) or
-    int64 with a NULL sentinel otherwise; the int8 class flag orders
-    NULL(0) < values(1) < NaN(2) and disambiguates sentinel collisions.
-    Equality of (data, flag) == SQL group equality (-0.0 == +0.0,
-    NaNs grouped together, NULLs grouped together).
-    """
-    out = []
-    for c in cols:
-        raw = comparable_data(c)
-        null = (~c.mask) if c.mask is not None else None
-        if jnp.issubdtype(raw.dtype, jnp.floating):
-            d = _canon_f64(raw)
-            flag = _float_class(raw, null)
-            if null is not None:
-                d = jnp.where(null, 0.0, d)
-        else:
-            d = _orderable_int64(raw)
-            if null is not None:
-                d = jnp.where(null, _INT64_MIN, d)
-                flag = jnp.where(null, jnp.int8(0), jnp.int8(1))
-            else:
-                flag = jnp.ones(d.shape[0], dtype=jnp.int8)
-        out.append((d, flag))
-    return out
 
 
 def _group_sort(parts, invalid_row: jax.Array) -> jax.Array:
@@ -395,7 +358,7 @@ def _join_key_parts(lcols: List[Column], rcols: List[Column]):
                 ra = ra.astype(jnp.float64) + 0.0
                 lh, rh = _f64_hash_part(la), _f64_hash_part(ra)
             else:
-                la, ra = _orderable_int64(la), _orderable_int64(ra)
+                la, ra = orderable_int64(la), orderable_int64(ra)
                 lh, rh = la.astype(jnp.uint64), ra.astype(jnp.uint64)
         lparts.append((lh, la))
         rparts.append((rh, ra))
@@ -625,7 +588,7 @@ class _Tracer:
                 col = table.columns[c.index]
                 raw = comparable_data(col)
                 if jnp.issubdtype(raw.dtype, jnp.floating):
-                    d = _canon_f64(raw)
+                    d = canon_f64(raw)
                     # NaN sorts last in BOTH directions (XLA/eager semantics:
                     # -NaN is still NaN) — the flag is never negated
                     nanflag = jnp.isnan(raw).astype(jnp.int8)
@@ -634,7 +597,7 @@ class _Tracer:
                     arrays.append(d)
                     arrays.append(nanflag)
                 else:
-                    d = _orderable_int64(raw)
+                    d = orderable_int64(raw)
                     if not c.ascending:
                         # -INT64_MIN wraps; clamp before negating (merges the
                         # two most-negative keys — unobservable in practice)
@@ -661,6 +624,21 @@ class _Tracer:
             count = jnp.sum(valid.astype(jnp.int64))
             valid = jnp.arange(stop - start) < (count - start)
         return _VT(table, valid)
+
+    def _LogicalWindow(self, rel) -> _VT:
+        from ..ops import window as W
+        src = self.run(rel.input)
+        names = list(src.table.names)
+        cols = list(src.table.columns)
+        for call in rel.calls:
+            order = [(c.index, c.ascending, c.effective_nulls_first)
+                     for c in call.order]
+            col = W.compute_window(src.table, call.op, call.args,
+                                   call.partition, order, call.frame,
+                                   call.stype, row_valid=src.valid)
+            cols.append(col)
+            names.append(call.name)
+        return _VT(Table(names, cols), src.valid)
 
     def _LogicalUnion(self, rel: LogicalUnion) -> _VT:
         from .rex.cast import cast_column

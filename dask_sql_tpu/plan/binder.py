@@ -484,10 +484,16 @@ class Binder:
         sub = Binder(self.catalog, self.sql, outer_scope=scope)
         sub.cte_stack = self.cte_stack[:]
         sub_plan = sub.bind_query(sq.query)
-        if not _plan_has_outer(sub_plan):
-            return False, plan  # uncorrelated: the eager-scalar path handles it
         if len(sub_plan.schema) != 1:
             self.error("Scalar subquery must return one column", sq)
+        if not _plan_has_outer(sub_plan):
+            # uncorrelated: reuse this bind instead of discarding it (the
+            # generic path would re-bind the whole subquery from scratch)
+            lhs = self.bind_expr(other_ast, scope)
+            t = sub_plan.schema[0].stype.with_nullable(True)
+            cmp = RexCall(op, [lhs, RexScalarSubquery(sub_plan, t)], BOOLEAN)
+            return True, LogicalFilter(input=plan, condition=cmp,
+                                       schema=list(plan.schema))
 
         # peel output projections above the aggregate (e.g. 0.2 * AVG(x))
         projects: List[LogicalProject] = []
@@ -1026,17 +1032,28 @@ class Binder:
             return RexCall(op, [l, r], SqlType("BOOLEAN", nullable=False))
         if isinstance(e, A.Subquery):
             if e.kind == "scalar":
-                sub = Binder(self.catalog, self.sql)
+                # bind with the outer scope visible so a correlated subquery
+                # in an unsupported position fails with a clear message, not
+                # a phantom "column not found"
+                sub = Binder(self.catalog, self.sql, outer_scope=scope)
                 sub.cte_stack = self.cte_stack[:]
                 sub_plan = sub.bind_query(e.query)
+                if _plan_has_outer(sub_plan):
+                    self.error(
+                        "Correlated scalar subqueries are only supported as "
+                        "top-level WHERE comparison conjuncts", e)
                 if len(sub_plan.schema) != 1:
                     self.error("Scalar subquery must return one column", e)
                 t = sub_plan.schema[0].stype.with_nullable(True)
                 return RexScalarSubquery(sub_plan, t)
             if e.kind == "exists":
-                sub = Binder(self.catalog, self.sql)
+                sub = Binder(self.catalog, self.sql, outer_scope=scope)
                 sub.cte_stack = self.cte_stack[:]
                 sub_plan = sub.bind_query(e.query)
+                if _plan_has_outer(sub_plan):
+                    self.error(
+                        "Correlated EXISTS is only supported as a top-level "
+                        "WHERE conjunct", e)
                 cnt = LogicalAggregate(
                     input=sub_plan, group_keys=[],
                     aggs=[AggCall("COUNT", [], False, BIGINT, "c")],

@@ -124,12 +124,21 @@ def test_runtime_fallback_nonunique_build(c):
 
 
 def test_unsupported_plan_falls_back(c):
-    # window functions are outside the compiled subset
+    # LAG reads its offset constant on the host: outside the compiled subset
     uns = compiled.stats["unsupported"]
-    r = c.sql("SELECT b, ROW_NUMBER() OVER (ORDER BY b) AS rn FROM df_simple",
+    r = c.sql("SELECT b, LAG(b, 1) OVER (ORDER BY b) AS lb FROM df_simple",
               return_futures=False)
-    assert list(r["rn"]) == [1, 2, 3]
+    assert r["lb"].tolist()[1:] == [1.1, 2.2]
     assert compiled.stats["unsupported"] > uns
+
+
+def test_window_compiles(c):
+    before = compiled.stats["compiles"] + compiled.stats["hits"]
+    r = c.sql("SELECT b, ROW_NUMBER() OVER (ORDER BY b DESC) AS rn, "
+              "SUM(b) OVER (PARTITION BY a) AS sb FROM df_simple",
+              return_futures=False)
+    assert compiled.stats["compiles"] + compiled.stats["hits"] == before + 1
+    assert sorted(r["rn"].tolist()) == [1, 2, 3]
 
 
 def test_compiled_disabled_by_env(c, monkeypatch):
