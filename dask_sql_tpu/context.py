@@ -226,6 +226,22 @@ class Context:
             f.write(text)
         return text
 
+    def profile(self, sql: str, trace_dir: str = "/tmp/dsql_trace"):
+        """Run a query under the XLA/JAX profiler and return the result.
+
+        The reference delegates profiling to the dask dashboard (SURVEY §5);
+        here device-side timing lives in an XLA trace viewable with
+        TensorBoard or Perfetto (``trace_dir`` holds the .trace files).
+        """
+        import jax
+
+        with jax.profiler.trace(trace_dir):
+            result = self.sql(sql)
+            for col in getattr(result, "columns", []):
+                col.data.block_until_ready()
+        logger.info("XLA trace written to %s", trace_dir)
+        return result
+
     # ----------------------------------------------------- catalog interface
     def fqn(self, identifier: Union[str, List[str]]) -> Tuple[str, str]:
         """Split a (qualified) name into (schema, name) (reference context.py:608-632)."""

@@ -271,13 +271,23 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
             # whole partition: segment reduce then broadcast
             total = segmented_scan(x, starts, combine)
             out = total[seg_end]
+        elif lo_off is None:
+            # UNBOUNDED PRECEDING .. k: prefix scan + one gather (an O(n)
+            # shift loop here would build an O(n^2) trace)
+            fwd = segmented_scan(x, starts, combine)
+            out = fwd[jnp.clip(pos + hi_off, seg_start, seg_end)]
+        elif hi_off is None:
+            # k .. UNBOUNDED FOLLOWING: suffix scan + one gather
+            bwd = jnp.flip(segmented_scan(jnp.flip(x), ends_flags, combine))
+            out = bwd[jnp.clip(pos + lo_off, seg_start, seg_end)]
         else:
             # bounded frame: windowed via per-offset shifts (frame sizes are
-            # small constants in practice)
-            lo = lo_off if lo_off is not None else -n
-            hi = hi_off if hi_off is not None else n
+            # small constants in practice; cap guards the trace size)
+            if hi_off - lo_off > 1024:
+                raise NotImplementedError(
+                    f"MIN/MAX over a {hi_off - lo_off}-row frame")
             out = x
-            for d in range(lo, hi + 1):
+            for d in range(lo_off, hi_off + 1):
                 if d == 0:
                     continue
                 src = jnp.clip(pos + d, 0, n - 1)

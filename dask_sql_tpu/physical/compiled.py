@@ -47,7 +47,7 @@ from .rex.evaluate import evaluate_predicate, evaluate_rex
 
 logger = logging.getLogger(__name__)
 
-_INT64_MIN = jnp.int64(-(2**63))
+from ..ops.kernels import _INT64_MIN  # single sentinel source
 _U64_MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
 _GOLDEN = np.uint64(0x9E3779B97F4A7C15)
 

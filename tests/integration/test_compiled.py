@@ -14,6 +14,11 @@ import pytest
 from dask_sql_tpu.physical import compiled
 
 
+_needs_compiled = pytest.mark.skipif(
+    os.environ.get("DSQL_COMPILE") == "0",
+    reason="asserts compiled-path usage; meaningless with DSQL_COMPILE=0")
+
+
 def _both_paths(c, query):
     """Run query compiled and eager; return (compiled_df, eager_df)."""
     comp = c.sql(query, return_futures=False)
@@ -74,6 +79,7 @@ def test_compiled_matches_eager(c, query, ordered):
     _assert_same(comp, eager, ordered)
 
 
+@_needs_compiled
 def test_compiled_path_used(c):
     before = compiled.stats["compiles"] + compiled.stats["hits"]
     c.sql("SELECT a, SUM(b) AS s FROM df GROUP BY a")
@@ -81,6 +87,7 @@ def test_compiled_path_used(c):
     assert after == before + 1
 
 
+@_needs_compiled
 def test_left_join_actually_compiles(c):
     """LEFT joins must run compiled (guards against trace-breaking syncs in
     the masked-gather path)."""
@@ -92,6 +99,7 @@ def test_left_join_actually_compiles(c):
     assert compiled.stats["unsupported"] == before_uns
 
 
+@_needs_compiled
 def test_cache_hit_on_repeat(c):
     q = "SELECT a, COUNT(*) AS n FROM df WHERE b < 9 GROUP BY a"
     c.sql(q)
@@ -100,6 +108,7 @@ def test_cache_hit_on_repeat(c):
     assert compiled.stats["hits"] == hits + 1
 
 
+@_needs_compiled
 def test_group_capacity_escalation(c, monkeypatch):
     # force a tiny initial capacity: the first run overflows, the host
     # recompiles with a doubled capacity, the result is still exact
@@ -111,6 +120,7 @@ def test_group_capacity_escalation(c, monkeypatch):
     assert compiled.stats["recompiles"] > rec
 
 
+@_needs_compiled
 def test_runtime_fallback_nonunique_build(c):
     # both sides have duplicate keys -> the unique-build invariant fails at
     # runtime; the flags vector reroutes to the eager executor, which handles
@@ -123,6 +133,7 @@ def test_runtime_fallback_nonunique_build(c):
     assert compiled.stats["fallbacks"] > fb
 
 
+@_needs_compiled
 def test_unsupported_plan_falls_back(c):
     # LAG reads its offset constant on the host: outside the compiled subset
     uns = compiled.stats["unsupported"]
@@ -132,6 +143,7 @@ def test_unsupported_plan_falls_back(c):
     assert compiled.stats["unsupported"] > uns
 
 
+@_needs_compiled
 def test_window_compiles(c):
     before = compiled.stats["compiles"] + compiled.stats["hits"]
     r = c.sql("SELECT b, ROW_NUMBER() OVER (ORDER BY b DESC) AS rn, "

@@ -1,5 +1,7 @@
 """Multi-device kernel tests on the virtual 8-device CPU mesh (SURVEY §4:
 same suite, mesh via env switch)."""
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -123,6 +125,8 @@ def test_engine_on_sharded_input(mesh, c):
     np.testing.assert_array_equal(result["n"], exp["count"])
 
 
+@pytest.mark.skipif(os.environ.get("DSQL_COMPILE") == "0",
+                    reason="asserts compiled-path usage")
 def test_context_mesh_mode_compiled(mesh):
     """Context(mesh=...): tables row-shard over the mesh (with padding +
     table validity) and queries run through the compiled SPMD path."""

@@ -113,3 +113,15 @@ def test_lag_lead(c):
            FROM ll""").to_pandas()
     assert list(result["lag1"].fillna(-1)) == [-1, 10, 20, -1, 40]
     assert list(result["lead1"].fillna(-1)) == [20, 30, -1, 50, -1]
+
+
+def test_unbounded_preceding_to_following_minmax(c):
+    """One-side-unbounded MIN/MAX frames must use scan+gather, not the
+    per-offset shift loop (which would build an O(n^2) trace)."""
+    r = c.sql(
+        "SELECT b, MIN(b) OVER (ORDER BY b ROWS BETWEEN UNBOUNDED PRECEDING "
+        "AND 1 FOLLOWING) AS m1, "
+        "MAX(b) OVER (ORDER BY b ROWS BETWEEN 1 PRECEDING AND UNBOUNDED "
+        "FOLLOWING) AS m2 FROM df_simple", return_futures=False)
+    assert r["m1"].tolist() == [1.1, 1.1, 1.1]
+    assert r["m2"].tolist() == [3.3, 3.3, 3.3]
