@@ -156,5 +156,42 @@ def main():
     }))
 
 
+def _run_with_watchdog():
+    """Run the benchmark in a child with a hard deadline.
+
+    The tunneled TPU can wedge mid-run (observed: 90+ minutes of silence
+    with no exception); the platform probe only guards initialization. The
+    parent re-runs on CPU if the child misses the deadline or dies without
+    emitting the JSON line, so this script ALWAYS prints its metric.
+    """
+    import subprocess
+
+    deadline = float(os.environ.get("BENCH_RUN_TIMEOUT", "1800"))
+    env = dict(os.environ, BENCH_CHILD="1")
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, timeout=deadline,
+                              capture_output=True, text=True)
+        out = proc.stdout
+    except subprocess.TimeoutExpired as e:
+        print(f"bench: TPU run exceeded {deadline}s; falling back to CPU",
+              file=sys.stderr)
+        out = ""
+    if '"metric"' in out:
+        sys.stdout.write(out)
+        return
+    env = dict(os.environ, BENCH_CHILD="1", BENCH_PLATFORM="cpu")
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, timeout=deadline, capture_output=True,
+                          text=True)
+    sys.stdout.write(proc.stdout)
+    if '"metric"' not in proc.stdout:
+        sys.stderr.write(proc.stderr[-2000:])
+        raise SystemExit(1)
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_CHILD") == "1":
+        main()
+    else:
+        _run_with_watchdog()

@@ -281,18 +281,35 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
             bwd = jnp.flip(segmented_scan(jnp.flip(x), ends_flags, combine))
             out = bwd[jnp.clip(pos + lo_off, seg_start, seg_end)]
         else:
-            # bounded frame: windowed via per-offset shifts (frame sizes are
-            # small constants in practice; cap guards the trace size)
-            if hi_off - lo_off > 1024:
-                raise NotImplementedError(
-                    f"MIN/MAX over a {hi_off - lo_off}-row frame")
-            out = x
-            for d in range(lo_off, hi_off + 1):
-                if d == 0:
-                    continue
-                src = jnp.clip(pos + d, 0, n - 1)
-                ok = (pos + d >= seg_start) & (pos + d <= seg_end)
-                out = combine(out, jnp.where(ok, x[src], sentinel))
+            # bounded frame: van Herk two-scan sliding window — O(n) for any
+            # frame width w. Width-w blocks get prefix/suffix scans; an
+            # UNCLIPPED frame [a, a+w-1] spans at most two blocks, so
+            # combine(blocksuffix[a], blockprefix[b]) covers it exactly.
+            # Frames clipped by a segment edge lose the alignment guarantee,
+            # so those rows select from plain segment scans instead.
+            w = max(hi_off - lo_off + 1, 1)
+            a_raw = pos + lo_off
+            b_raw = pos + hi_off
+            low_clip = a_raw < seg_start
+            high_clip = b_raw > seg_end
+            block_flags = (pos % w) == 0
+            fwd_vh = segmented_scan(x, starts | block_flags, combine)
+            rev_block = jnp.flip((pos % w) == (w - 1))
+            rev_block = rev_block.at[0].set(True)
+            bwd_vh = jnp.flip(segmented_scan(jnp.flip(x),
+                                             ends_flags | rev_block, combine))
+            fwd_seg = segmented_scan(x, starts, combine)
+            bwd_seg = jnp.flip(segmented_scan(jnp.flip(x), ends_flags,
+                                              combine))
+            a_s = jnp.clip(a_raw, 0, n - 1)
+            b_s = jnp.clip(b_raw, 0, n - 1)
+            vh = combine(bwd_vh[a_s], fwd_vh[b_s])
+            cum = fwd_seg[jnp.clip(b_raw, seg_start, seg_end)]
+            suf = bwd_seg[jnp.clip(a_raw, seg_start, seg_end)]
+            tot = fwd_seg[seg_end]
+            out = jnp.where(low_clip & high_clip, tot,
+                            jnp.where(low_clip, cum,
+                                      jnp.where(high_clip, suf, vh)))
             in_frame_cnt = window_frame_sums(valid.astype(jnp.int64),
                                              seg_start, seg_end, lo_off, hi_off)
             m = in_frame_cnt > 0

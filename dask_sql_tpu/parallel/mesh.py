@@ -102,3 +102,27 @@ def shard_table(table, mesh: Mesh):
             mask = jax.device_put(mask, sh)
         cols.append(Column(data, c.stype, mask, c.dictionary))
     return Table(list(table.names), cols), n
+
+
+def init_multihost(coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> Mesh:
+    """Attach this host to a multi-host mesh (DCN) and return the row mesh.
+
+    The reference attaches a `dask.distributed.Client` to an external
+    scheduler (SURVEY §2.3, fixtures.py:291-297); the SPMD equivalent is
+    ``jax.distributed.initialize`` — every host runs the same driver
+    program, the mesh spans all hosts' devices, and XLA routes collectives
+    over ICI within a slice and DCN across slices. On a single host (or
+    under test) this degrades to the local mesh.
+    """
+    if coordinator_address is not None:
+        try:
+            jax.distributed.initialize(coordinator_address=coordinator_address,
+                                       num_processes=num_processes,
+                                       process_id=process_id)
+        except RuntimeError as e:
+            # already initialized: degrade to the existing mesh, as promised
+            if "already" not in str(e).lower():
+                raise
+    return default_mesh()

@@ -181,3 +181,11 @@ def test_mesh_mode_count_ignores_padding(mesh):
               return_futures=False)
     assert r["n"][0] == 13
     assert r["s"][0] == 78.0
+
+
+def test_init_multihost_single_host(mesh):
+    """Without a coordinator the helper degrades to the local mesh."""
+    from dask_sql_tpu.parallel.mesh import init_multihost
+
+    m = init_multihost()
+    assert m.devices.size == len(jax.devices())

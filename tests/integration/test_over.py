@@ -116,12 +116,57 @@ def test_lag_lead(c):
 
 
 def test_unbounded_preceding_to_following_minmax(c):
-    """One-side-unbounded MIN/MAX frames must use scan+gather, not the
-    per-offset shift loop (which would build an O(n^2) trace)."""
+    """One-side-unbounded MIN/MAX frames use scan+gather, not a shift loop;
+    aggregate a DIFFERENT column than the order key so the bounded offset
+    actually matters."""
+    import pandas as pd
+    c.create_table("wf_t", pd.DataFrame({"o": [1, 2, 3, 4],
+                                         "v": [5.0, 1.0, 7.0, 3.0]}))
     r = c.sql(
-        "SELECT b, MIN(b) OVER (ORDER BY b ROWS BETWEEN UNBOUNDED PRECEDING "
+        "SELECT o, MIN(v) OVER (ORDER BY o ROWS BETWEEN UNBOUNDED PRECEDING "
         "AND 1 FOLLOWING) AS m1, "
-        "MAX(b) OVER (ORDER BY b ROWS BETWEEN 1 PRECEDING AND UNBOUNDED "
-        "FOLLOWING) AS m2 FROM df_simple", return_futures=False)
-    assert r["m1"].tolist() == [1.1, 1.1, 1.1]
-    assert r["m2"].tolist() == [3.3, 3.3, 3.3]
+        "MAX(v) OVER (ORDER BY o ROWS BETWEEN 1 PRECEDING AND UNBOUNDED "
+        "FOLLOWING) AS m2 FROM wf_t ORDER BY o", return_futures=False)
+    assert r["m1"].tolist() == [1.0, 1.0, 1.0, 1.0]
+    assert r["m2"].tolist() == [7.0, 7.0, 7.0, 7.0]
+    r2 = c.sql(
+        "SELECT o, MIN(v) OVER (ORDER BY o ROWS BETWEEN UNBOUNDED PRECEDING "
+        "AND 1 PRECEDING) AS m FROM wf_t ORDER BY o", return_futures=False)
+    # first row's frame is empty -> NULL
+    import numpy as np
+    assert np.isnan(r2["m"].iloc[0])
+    assert r2["m"].tolist()[1:] == [5.0, 1.0, 1.0]
+
+
+def test_bounded_minmax_frames_vs_bruteforce(c):
+    """van Herk sliding MIN/MAX vs brute force over random data, partitions,
+    and frame shapes (incl. frames clipped at segment edges)."""
+    import numpy as np
+    import pandas as pd
+    rng = np.random.RandomState(42)
+    n = 200
+    df = pd.DataFrame({"p": rng.randint(0, 5, n),
+                       "o": rng.permutation(n),
+                       "v": rng.randn(n).round(3)})
+    c.create_table("vh_t", df)
+    for lo, hi in ((-2, 1), (-7, -3), (2, 9), (-4, 0), (0, 4)):
+        lo_s = f"{-lo} PRECEDING" if lo < 0 else (
+            "CURRENT ROW" if lo == 0 else f"{lo} FOLLOWING")
+        hi_s = f"{-hi} PRECEDING" if hi < 0 else (
+            "CURRENT ROW" if hi == 0 else f"{hi} FOLLOWING")
+        q = (f"SELECT p, o, v, MIN(v) OVER (PARTITION BY p ORDER BY o "
+             f"ROWS BETWEEN {lo_s} AND {hi_s}) AS mn, "
+             f"MAX(v) OVER (PARTITION BY p ORDER BY o "
+             f"ROWS BETWEEN {lo_s} AND {hi_s}) AS mx FROM vh_t")
+        r = c.sql(q, return_futures=False).sort_values(["p", "o"],
+                                                       ignore_index=True)
+        for p in range(5):
+            grp = df[df.p == p].sort_values("o").reset_index(drop=True)
+            got = r[r.p == p].reset_index(drop=True)
+            for i in range(len(grp)):
+                window = grp.v.iloc[max(i + lo, 0): max(i + hi + 1, 0)]
+                if len(window):
+                    assert got.mn[i] == window.min(), (lo, hi, p, i)
+                    assert got.mx[i] == window.max(), (lo, hi, p, i)
+                else:
+                    assert pd.isna(got.mn[i]), (lo, hi, p, i)
