@@ -309,14 +309,16 @@ def orderable_int64(x: jax.Array) -> jax.Array:
     return x.astype(jnp.int64)
 
 
-def key_parts(cols: List[Column]) -> List[Tuple[jax.Array, jax.Array]]:
-    """(data, class flag) per key column for grouping/dedup.
+def key_parts(cols: List[Column]) -> List[Tuple[jax.Array, Optional[jax.Array]]]:
+    """(data, optional class flag) per key column for grouping/dedup.
 
     data is canonical f64 for float columns (no 64-bit bitcast on TPU) or
     int64 with a NULL sentinel otherwise; the int8 class flag orders
     NULL(0) < values(1) < NaN(2) and disambiguates sentinel collisions.
-    Equality of (data, flag) == SQL group equality (-0.0 == +0.0,
-    NaNs grouped together, NULLs grouped together).
+    flag is None for non-nullable integer-like keys — nothing to
+    disambiguate, and every flag array is one more lexsort operand over
+    the whole stream. Equality of (data, flag) == SQL group equality
+    (-0.0 == +0.0, NaNs grouped together, NULLs grouped together).
     """
     out = []
     for c in cols:
@@ -333,8 +335,34 @@ def key_parts(cols: List[Column]) -> List[Tuple[jax.Array, jax.Array]]:
                 d = jnp.where(null, _INT64_MIN, d)
                 flag = jnp.where(null, jnp.int8(0), jnp.int8(1))
             else:
-                flag = jnp.ones(d.shape[0], dtype=jnp.int8)
+                flag = None
         out.append((d, flag))
     return out
 
 
+
+
+def append_lexsort_operands(arrays: list, parts) -> None:
+    """Append key-part lexsort operands (data + optional class flag) in
+    least-to-most-significant order for ``jnp.lexsort`` consumers."""
+    for d, flag in reversed(parts):
+        arrays.append(d)
+        if flag is not None:
+            arrays.append(flag)
+
+
+def part_boundaries(parts, perm: jax.Array) -> jax.Array:
+    """Boundary mask over the permuted stream: True where any key part (data
+    or class flag) differs from the previous row. Row 0 is always True.
+    The single definition both GROUP BY factorization and window
+    partitioning rely on — they must agree on group equality."""
+    n = perm.shape[0]
+    boundary = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for d, flag in parts:
+        ds = d[perm]
+        diff = ds[1:] != ds[:-1]
+        if flag is not None:
+            fs = flag[perm]
+            diff = diff | (fs[1:] != fs[:-1])
+        boundary = boundary | jnp.concatenate([jnp.ones(1, bool), diff])
+    return boundary

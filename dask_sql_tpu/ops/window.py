@@ -22,7 +22,8 @@ import numpy as np
 
 from ..table import dict_sort_order, Column, Scalar, Table
 from ..types import SqlType, physical_dtype
-from .kernels import comparable_data, key_parts
+from .kernels import (append_lexsort_operands, comparable_data,
+                      key_parts, part_boundaries)
 
 # window ops whose kernels are fully trace-safe (the compiled executor's
 # supported subset; the rest read host constants)
@@ -119,20 +120,14 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
             arrays.append(data)
     part_parts = key_parts([table.columns[i] for i in partition_cols]) \
         if partition_cols else []
-    for d, flag in part_parts:
-        arrays.append(d)
-        arrays.append(flag)
+    append_lexsort_operands(arrays, list(reversed(part_parts)))
     if row_valid is not None:
         arrays.append((~row_valid).astype(jnp.int8))  # invalid rows last
     perm = jnp.lexsort(arrays) if arrays else jnp.arange(n)
     inv_perm = jnp.argsort(perm)  # scatter-free inverse
 
     # 2. segment starts from sorted partition-part diffs (+ validity edge)
-    starts = jnp.zeros(n, dtype=bool).at[0].set(True)
-    for d, flag in part_parts:
-        ds, fs = d[perm], flag[perm]
-        starts = starts | jnp.concatenate(
-            [jnp.ones(1, bool), (ds[1:] != ds[:-1]) | (fs[1:] != fs[:-1])])
+    starts = part_boundaries(part_parts, perm)
     if row_valid is not None:
         vs = row_valid[perm]
         starts = starts | jnp.concatenate(

@@ -34,7 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import groupby as G
-from ..ops.kernels import (canon_f64, comparable_data, float_class,
+from ..ops.kernels import (append_lexsort_operands, canon_f64,
+                           comparable_data, float_class, part_boundaries,
                            key_parts as _key_parts, orderable_int64,
                            unify_string_codes)
 from ..plan.nodes import (
@@ -204,10 +205,8 @@ class _VT:
 def _group_sort(parts, invalid_row: jax.Array) -> jax.Array:
     """Stable permutation: invalid rows last; keys null-first ascending."""
     arrays = []
-    for d, flag in reversed(parts):
-        arrays.append(d)
-        # flag is more significant than data: NULL first, NaN last
-        arrays.append(flag)
+    # flag (when present) is more significant than data: NULL first, NaN last
+    append_lexsort_operands(arrays, parts)
     arrays.append(invalid_row.astype(jnp.int8))  # primary: valid rows first
     return jnp.lexsort(arrays)
 
@@ -237,12 +236,7 @@ def _group_sorted_codes(key_cols: List[Column],
     perm = _group_sort(parts, invalid)
 
     valid_sorted = ~invalid[perm]
-    boundary = jnp.zeros(n, dtype=bool)
-    for d, flag in parts:
-        ds, fs = d[perm], flag[perm]
-        boundary = boundary | jnp.concatenate(
-            [jnp.ones(1, bool), (ds[1:] != ds[:-1]) | (fs[1:] != fs[:-1])])
-    boundary = boundary & valid_sorted
+    boundary = part_boundaries(parts, perm) & valid_sorted
     codes_sorted = jnp.cumsum(boundary.astype(jnp.int64)) - 1
     # last valid row's code + 1; if no valid rows, 0
     num_groups = jnp.where(

@@ -1,12 +1,22 @@
 """Packaging for dask_sql_tpu (reference: /root/reference/setup.py console
 scripts at :106-111; no jar build step — the planner is native Python/C++)."""
 from setuptools import find_packages, setup
+from setuptools.dist import Distribution
+
+
+class _BinaryDistribution(Distribution):
+    """The prebuilt native parser makes this a platform wheel."""
+
+    def has_ext_modules(self):
+        return True
+
 
 setup(
     name="dask_sql_tpu",
     version="0.1.0",
     description="TPU-native distributed SQL query engine (dask-sql capability parity)",
     packages=find_packages(include=["dask_sql_tpu", "dask_sql_tpu.*"]),
+    package_data={"dask_sql_tpu.native": ["*.so"]},
     python_requires=">=3.10",
     install_requires=[
         "jax",
@@ -24,4 +34,5 @@ setup(
             "dask-sql-tpu-server = dask_sql_tpu.server.app:main",
         ]
     },
+    distclass=_BinaryDistribution,
 )
