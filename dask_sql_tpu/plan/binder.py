@@ -818,6 +818,11 @@ class Binder:
         # validate: post exprs only reference agg-output ordinals
         # build pre-projection
         pre_exprs = collector.pre_exprs
+        if not pre_exprs and plan.schema:
+            # COUNT(*) with no group keys references no columns at all; keep
+            # one input ref so the pre-projection still carries the row count
+            # (a zero-column table has no length)
+            pre_exprs = [RexInputRef(0, plan.schema[0].stype)]
         pre_fields = [Field(f"$f{i}", r.stype) for i, r in enumerate(pre_exprs)]
         pre = LogicalProject(input=plan, exprs=pre_exprs, schema=pre_fields)
 

@@ -266,7 +266,7 @@ def _prune(rel: RelNode, needed: Set[int]) -> Tuple[RelNode, Dict[int, int]]:
         return LogicalTableScan(rel.schema_name, rel.table_name, new_schema), mapping
 
     if isinstance(rel, LogicalProject):
-        keep = sorted(needed) if needed else [0]
+        keep = sorted(needed) if needed else ([0] if rel.exprs else [])
         child_needed: Set[int] = set()
         for i in keep:
             child_needed.update(rex_inputs(rel.exprs[i]))

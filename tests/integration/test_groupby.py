@@ -105,6 +105,20 @@ def test_count_star(c, long_table):
     assert_eq(result, expected, check_row_order=False)
 
 
+def test_count_star_no_group(c, long_table, user_table_1):
+    # whole-table COUNT(*) references no input columns at all; the plan must
+    # still carry the row count through the pruned pre-projection
+    result = c.sql("SELECT COUNT(*) AS n FROM long_table")
+    assert_eq(result, pd.DataFrame({"n": [len(long_table)]}))
+    result = c.sql("SELECT COUNT(*) AS n FROM user_table_1 WHERE user_id = 2")
+    assert_eq(result, pd.DataFrame({"n": [int((user_table_1.user_id == 2).sum())]}))
+    result = c.sql(
+        "SELECT COUNT(*) AS n FROM user_table_1 t1, user_table_1 t2 "
+        "WHERE t1.user_id = t2.b")
+    merged = user_table_1.merge(user_table_1, left_on="user_id", right_on="b")
+    assert_eq(result, pd.DataFrame({"n": [len(merged)]}))
+
+
 def test_having(c, user_table_1):
     result = c.sql(
         "SELECT user_id, SUM(b) AS s FROM user_table_1 GROUP BY user_id HAVING SUM(b) > 3")
