@@ -77,9 +77,19 @@ def generate_tpch(sf: float = 0.01, seed: int = 0) -> dict:
         "p_comment": ["" for _ in range(n_part)],
     })
     n_ps = n_part * 4
+    # dbgen invariant: (ps_partkey, ps_suppkey) is a primary key — each part
+    # gets 4 DISTINCT suppliers via a strided formula, and lineitem picks
+    # its supplier from the part's four (so l_partkey/l_suppkey pairs exist
+    # in partsupp; Q9's two-key join depends on both properties)
+    _ps_step = max(n_supp // 4, 1)
+
+    def _psupp(partkey, i):
+        return (partkey - 1 + i * _ps_step) % n_supp + 1
+
     partsupp = pd.DataFrame({
         "ps_partkey": np.repeat(np.arange(1, n_part + 1), 4),
-        "ps_suppkey": rng.randint(1, n_supp + 1, n_ps),
+        "ps_suppkey": _psupp(np.repeat(np.arange(1, n_part + 1), 4),
+                             np.tile(np.arange(4), n_part)),
         "ps_availqty": rng.randint(1, 10_000, n_ps),
         "ps_supplycost": np.round(rng.uniform(1.0, 1000.0, n_ps), 2),
         "ps_comment": ["" for _ in range(n_ps)],
@@ -118,8 +128,8 @@ def generate_tpch(sf: float = 0.01, seed: int = 0) -> dict:
                           rng.choice(["R", "A"], n_li), "N")
     lineitem = pd.DataFrame({
         "l_orderkey": li_order,
-        "l_partkey": rng.randint(1, n_part + 1, n_li),
-        "l_suppkey": rng.randint(1, n_supp + 1, n_li),
+        "l_partkey": (li_partkey := rng.randint(1, n_part + 1, n_li)),
+        "l_suppkey": _psupp(li_partkey, rng.randint(0, 4, n_li)),
         "l_linenumber": np.concatenate([np.arange(1, k + 1) for k in lines_per_order]),
         "l_quantity": rng.randint(1, 51, n_li).astype(np.float64),
         "l_extendedprice": np.round(rng.uniform(900.0, 105_000.0, n_li), 2),

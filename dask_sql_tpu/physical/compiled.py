@@ -34,8 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import groupby as G
-from ..ops.kernels import (append_lexsort_operands, canon_f64,
-                           comparable_data, float_class, part_boundaries,
+from ..ops.kernels import (canon_f64, comparable_data, float_class,
                            key_parts as _key_parts, orderable_int64,
                            unify_string_codes)
 from ..plan.nodes import (
@@ -202,41 +201,119 @@ class _VT:
         return self.valid
 
 
-def _group_sort(parts, invalid_row: jax.Array) -> jax.Array:
-    """Stable permutation: invalid rows last; keys null-first ascending."""
-    arrays = []
-    # flag (when present) is more significant than data: NULL first, NaN last
-    append_lexsort_operands(arrays, parts)
-    arrays.append(invalid_row.astype(jnp.int8))  # primary: valid rows first
-    return jnp.lexsort(arrays)
+def _hash_group_parts(parts) -> jax.Array:
+    """Mix all group-key parts (data + class flags) into one u64 per row.
+
+    Float parts ride the lossy double-float encoding (_f64_hash_part);
+    any loss only ever ADDS collisions, which the caller detects against
+    the raw parts and routes to the eager fallback."""
+    h = jnp.full(parts[0][0].shape, _GOLDEN, dtype=jnp.uint64)
+    for d, flag in parts:
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            hp = _f64_hash_part(d)
+        else:
+            hp = d.astype(jnp.uint64)
+        h = _mix64(h + hp + _GOLDEN)
+        if flag is not None:
+            h = _mix64(h + flag.astype(jnp.uint64) + _GOLDEN)
+    return h
 
 
 class _GroupSorted:
     """Group-sorted stream: the one factorize result both the aggregate and
-    UNION DISTINCT paths consume (scatter-free; see ops/sorted_agg.py)."""
+    UNION DISTINCT paths consume (scatter-free; see ops/sorted_agg.py).
+
+    ``collision`` is a traced scalar bool: True when a 64-bit key-hash
+    collision may have interleaved two distinct groups (hash-combined sort
+    path only); callers must append it to the tracer's fallback flags."""
 
     __slots__ = ("perm", "valid_sorted", "codes_sorted", "num_groups",
-                 "starts", "ends", "first_rows", "n", "cap")
+                 "starts", "ends", "first_rows", "n", "cap", "collision",
+                 "payload_sorted")
 
 
 def _group_sorted_codes(key_cols: List[Column],
                         row_valid: Optional[jax.Array],
-                        cap: int) -> _GroupSorted:
+                        cap: int,
+                        payload: Tuple[jax.Array, ...] = ()) -> _GroupSorted:
     """Sort rows into group order and derive dense codes in sorted space.
 
-    Group order matches the eager factorize (null-first, ascending per key);
-    invalid rows and groups beyond ``cap`` land in the trash slot ``cap``.
+    Invalid rows and groups beyond ``cap`` land in the trash slot ``cap``.
     Stable sort makes ``first_rows[g]`` the group's first original row.
+
+    ``payload`` arrays ride the sort as extra variadic-sort operands and come
+    back group-ordered in ``gs.payload_sorted``. On TPU a random n-element
+    gather costs ~2x a whole extra sort operand (profiled on the bench
+    workload: 32ms gather vs full 7ms u64 argsort at 1.8M rows), so callers
+    should ship every column they need in sorted space through here rather
+    than ``take(gs.perm)`` afterwards. Key parts also ride as payload, which
+    makes boundary detection gather-free.
+
+    With >2 key sort operands, all parts collapse into ONE u64 hash key
+    (sort cost scales with key-operand count); group order is then hash
+    order — unordered, as SQL allows; an explicit ORDER BY sorts above the
+    aggregate anyway. Distinct keys sharing a hash would interleave; that is
+    detected (adjacent equal-hash rows across a raw-key boundary) and
+    reported via ``collision`` for the runtime fallback flag.
     """
     from ..ops import sorted_agg as sa
 
     n = len(key_cols[0])
     parts = _key_parts(key_cols)
     invalid = jnp.zeros(n, dtype=bool) if row_valid is None else ~row_valid
-    perm = _group_sort(parts, invalid)
+    n_operands = sum(2 if flag is not None else 1 for _, flag in parts)
+    hashed = n_operands > 2
 
-    valid_sorted = ~invalid[perm]
-    boundary = part_boundaries(parts, perm) & valid_sorted
+    # key operands, most significant first (invalid rows last; within a
+    # part the class flag outranks the data: NULL first, NaN last)
+    key_ops: List[jax.Array] = [invalid]
+    if hashed:
+        key_ops.append(_hash_group_parts(parts))
+    else:
+        for d, flag in parts:
+            if flag is not None:
+                key_ops.append(flag)
+            key_ops.append(d)
+
+    part_pay: List[jax.Array] = []
+    if hashed:
+        for d, flag in parts:
+            part_pay.append(d)
+            if flag is not None:
+                part_pay.append(flag)
+
+    nk = len(key_ops)
+    iota = jnp.arange(n, dtype=jnp.int64)
+    outs = jax.lax.sort(tuple(key_ops) + (iota,) + tuple(part_pay)
+                        + tuple(payload), num_keys=nk, is_stable=True)
+    perm = outs[nk]
+    valid_sorted = ~outs[0]
+    payload_sorted = outs[nk + 1 + len(part_pay):]
+
+    # adjacent-difference boundaries over the sorted key parts — no gathers
+    if hashed:
+        it = iter(outs[nk + 1: nk + 1 + len(part_pay)])
+        parts_sorted = [(next(it), next(it) if flag is not None else None)
+                        for _, flag in parts]
+    else:
+        it = iter(outs[1:nk])
+        parts_sorted = [((next(it) if flag is not None else None), next(it))
+                        for _, flag in parts]
+        parts_sorted = [(d, f) for f, d in parts_sorted]
+    diff = jnp.zeros(n - 1, dtype=bool) if n > 1 else jnp.zeros(0, dtype=bool)
+    for d, flag in parts_sorted:
+        diff = diff | (d[1:] != d[:-1])
+        if flag is not None:
+            diff = diff | (flag[1:] != flag[:-1])
+    boundary = jnp.concatenate([jnp.ones(min(n, 1), dtype=bool), diff])
+    boundary = boundary & valid_sorted
+
+    collision = jnp.zeros((), dtype=bool)
+    if hashed:
+        hs = outs[1]
+        adj_pair = valid_sorted[1:] & valid_sorted[:-1]
+        collision = (adj_pair & (hs[1:] == hs[:-1]) & boundary[1:]).any()
+
     codes_sorted = jnp.cumsum(boundary.astype(jnp.int64)) - 1
     # last valid row's code + 1; if no valid rows, 0
     num_groups = jnp.where(
@@ -247,6 +324,8 @@ def _group_sorted_codes(key_cols: List[Column],
     gs = _GroupSorted()
     gs.perm, gs.valid_sorted, gs.codes_sorted = perm, valid_sorted, codes_sorted
     gs.num_groups, gs.n, gs.cap = num_groups, n, cap
+    gs.collision = collision
+    gs.payload_sorted = payload_sorted
     gs.starts, gs.ends = sa.segment_bounds(codes_sorted, cap)
     gs.first_rows = perm[jnp.clip(gs.starts, 0, max(n - 1, 0))]
     return gs
@@ -255,12 +334,11 @@ def _group_sorted_codes(key_cols: List[Column],
 def _traced_factorize(key_cols: List[Column], row_valid: Optional[jax.Array],
                       cap: int):
     """Original-row-order codes view of _group_sorted_codes (UNION DISTINCT
-    needs codes per input row; the inverse permutation is an argsort, not a
-    scatter)."""
+    needs codes per input row). The un-sort is a payload sort keyed on the
+    permutation — half the cost of the argsort + random gather it replaces."""
     gs = _group_sorted_codes(key_cols, row_valid, cap)
-    inv = jnp.argsort(gs.perm)
-    codes = gs.codes_sorted[inv]
-    return codes, gs.first_rows, gs.num_groups
+    _, codes = jax.lax.sort((gs.perm, gs.codes_sorted), num_keys=1)
+    return codes, gs.first_rows, gs.num_groups, gs.collision
 
 
 STATIC_DOMAIN_CAP = 4096
@@ -455,19 +533,42 @@ class _Tracer:
         tag = f"agg{self._agg_counter}"
         self._agg_counter += 1
         cap = min(self.caps.get(tag, DEFAULT_GROUP_CAP), n)
-        gs = _group_sorted_codes(key_cols, src.valid, cap)
+
+        # every column an aggregate reads rides the group sort as payload —
+        # cheaper than a post-sort take(perm) random gather per column
+        need: List[int] = []
+        for agg in rel.aggs:
+            for idx in (list(agg.args[:1])
+                        + ([agg.filter_arg] if agg.filter_arg is not None
+                           else [])):
+                if idx not in need:
+                    need.append(idx)
+        payload: List[jax.Array] = []
+        pay_slots: Dict[int, Tuple[int, Optional[int]]] = {}
+        for idx in need:
+            col = src.table.columns[idx]
+            di = len(payload)
+            payload.append(col.data)
+            mi = None
+            if col.mask is not None:
+                mi = len(payload)
+                payload.append(col.mask)
+            pay_slots[idx] = (di, mi)
+
+        gs = _group_sorted_codes(key_cols, src.valid, cap, tuple(payload))
+        self.fallback.append(gs.collision)
         self.ngroups.append(gs.num_groups)
         self.ngroup_caps.append(cap)
 
         for ki in rel.group_keys:
             out_cols.append(src.table.columns[ki].take(gs.first_rows))
 
-        sorted_cols: Dict[int, Column] = {}
-
         def _sorted_col(idx: int) -> Column:
-            if idx not in sorted_cols:
-                sorted_cols[idx] = src.table.columns[idx].take(gs.perm)
-            return sorted_cols[idx]
+            di, mi = pay_slots[idx]
+            col = src.table.columns[idx]
+            mask = gs.payload_sorted[mi] if mi is not None else None
+            return Column(gs.payload_sorted[di], col.stype, mask,
+                          col.dictionary)
 
         for j, agg in enumerate(rel.aggs):
             f = rel.schema[len(rel.group_keys) + j]
@@ -656,8 +757,9 @@ class _Tracer:
             return out
         # UNION DISTINCT: keep first occurrence of each distinct row
         n = out.n
-        codes, first, _ = _traced_factorize(list(out.table.columns),
-                                            out.valid, n)
+        codes, first, _, collision = _traced_factorize(
+            list(out.table.columns), out.valid, n)
+        self.fallback.append(collision)
         keep = jnp.clip(first, 0, n - 1)[codes] == jnp.arange(n)
         keep = keep & out.vmask()
         return _VT(out.table, keep)
@@ -705,30 +807,73 @@ class _Tracer:
         ph = _hash_parts(pparts, pvalid)
         bh = _hash_parts(bparts, bvalid)
 
-        nb = build.n
-        order = jnp.argsort(bh)
-        bh_sorted = bh[order]
-        adj = (bh_sorted[1:] == bh_sorted[:-1]) & (bh_sorted[1:] != _U64_MAX)
+        # --- merge join: ONE stable sort of the concatenated hash streams
+        # with payload channels, an associative "last build row" carry scan,
+        # and one unsort keyed on the original position. Zero probe-length
+        # random gathers: on TPU a single n-element gather costs ~2x a whole
+        # extra sort operand (profiled: 32ms gather vs 7ms u64 argsort at
+        # 1.8M rows), and the old probe did one gather per verify part plus
+        # one per build output column.
+        nb, npr = build.n, probe.n
+        m = nb + npr
+        h_m = jnp.concatenate([bh, ph])
+        flag_b = jnp.concatenate([jnp.ones(nb, bool), jnp.zeros(npr, bool)])
+        idt = jnp.int32 if m < 2**31 else jnp.int64
+        iota_m = jnp.arange(m, dtype=idt)
+        raw_ch = [jnp.concatenate([braw, praw])
+                  for (_, braw), (_, praw) in zip(bparts, pparts)]
+        need_cols = jt in ("INNER", "LEFT", "RIGHT")
+        col_ch: List[jax.Array] = []
+        if need_cols:
+            for c0 in build.table.columns:
+                col_ch.append(jnp.concatenate(
+                    [c0.data, jnp.zeros(npr, dtype=c0.data.dtype)]))
+                if c0.mask is not None:
+                    col_ch.append(jnp.concatenate(
+                        [c0.mask, jnp.zeros(npr, dtype=bool)]))
+
+        outs = jax.lax.sort((h_m, flag_b, iota_m, *raw_ch, *col_ch),
+                            num_keys=1, is_stable=True)
+        hs, fbs, iotas = outs[0], outs[1], outs[2]
+        raws = outs[3:3 + len(raw_ch)]
+        colss = outs[3 + len(raw_ch):]
+
+        # equal-hash build rows are contiguous (stable sort puts build rows
+        # before same-hash probe rows), so duplicates/collisions show up as
+        # adjacent build pairs — no scan needed for the flags
+        adj = fbs[1:] & fbs[:-1] & (hs[1:] == hs[:-1]) & (hs[1:] != _U64_MAX)
         if jt in ("INNER", "LEFT", "RIGHT"):
             # build side must be unique on the key (covers hash collisions too)
             self.fallback.append(adj.any())
         else:
             # duplicates fine for SEMI/ANTI; only hash collisions are fatal
             coll = jnp.zeros((), dtype=bool)
-            for _, raw in bparts:
-                raws = raw[order]
-                coll = coll | (adj & (raws[1:] != raws[:-1])).any()
+            for r in raws:
+                coll = coll | (adj & (r[1:] != r[:-1])).any()
             self.fallback.append(coll)
 
-        # method="sort" is 2.2x faster than "scan_unrolled" for large probe
-        # sides on TPU (A/B measured on the bench workload)
-        pos = jnp.searchsorted(bh_sorted, ph, side="left", method="sort")
-        in_range = pos < nb
-        pos_c = jnp.minimum(pos, nb - 1)
-        cand = order[pos_c]
-        match = in_range & pvalid & (bh_sorted[pos_c] == ph)
-        for (_, praw), (_, braw) in zip(pparts, bparts):
-            match = match & (praw == braw[cand])
+        def carry_op(a, b):
+            take = b[0]
+            return tuple([a[0] | b[0]]
+                         + [jnp.where(take, bv, av)
+                            for av, bv in zip(a[1:], b[1:])])
+
+        carried = jax.lax.associative_scan(
+            carry_op, (fbs, *raws, *colss))
+        has_b = carried[0]
+        c_raws = carried[1:1 + len(raws)]
+        c_cols = carried[1 + len(raws):]
+
+        # a probe row matches iff the last build row at-or-before it has the
+        # same raw key (equal raw => equal hash, and everything between them
+        # in hash order then shares that hash)
+        match_s = (~fbs) & has_b
+        for cr, r in zip(c_raws, raws):
+            match_s = match_s & (cr == r)
+
+        un = jax.lax.sort((iotas, match_s, *c_cols), num_keys=1)
+        match = un[1][nb:] & pvalid
+        ub_cols = [o[nb:] for o in un[2:]]
 
         if jt == "SEMI":
             return _VT(probe.table.with_names(out_names),
@@ -737,7 +882,12 @@ class _Tracer:
             return _VT(probe.table.with_names(out_names),
                        probe.vmask() & ~match)
 
-        gathered = [c.take(cand) for c in build.table.columns]
+        gathered: List[Column] = []
+        it = iter(ub_cols)
+        for c0 in build.table.columns:
+            data = next(it)
+            mask = next(it) if c0.mask is not None else None
+            gathered.append(Column(data, c0.stype, mask, c0.dictionary))
         if jt in ("LEFT", "RIGHT"):
             gathered = [c.with_mask(c.valid_mask() & match) for c in gathered]
         if probe_is_left:
@@ -781,6 +931,7 @@ _cache: "OrderedDict[tuple, object]" = OrderedDict()
 # compiled attempt; bounded like the program cache
 _learned_caps: "OrderedDict[tuple, Dict[str, int]]" = OrderedDict()
 _runtime_eager: "OrderedDict[tuple, bool]" = OrderedDict()
+_compile_failures: "OrderedDict[tuple, int]" = OrderedDict()
 _LEARNED_LIMIT = 1024
 _UNSUPPORTED = object()
 
@@ -977,15 +1128,26 @@ def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
             except Exception as e:
                 # trace-time concretization errors (host-bound kernels) and
                 # backend compile failures (e.g. an op outside the TPU X64
-                # rewrite) both land here: the eager path is the answer
+                # rewrite) both land here: the eager path is the answer.
+                # Backend errors can also be TRANSIENT (a remote-TPU tunnel
+                # dropping mid-compile), so the verdict only sticks after a
+                # second failure — one retry on the next call is cheap
+                # against permanently exiling a hot plan to the eager path.
                 logger.warning("compiled path failed for this plan (%s: %s); "
                                "using eager executor", type(e).__name__,
                                str(e)[:200])
-                _cache[key] = _UNSUPPORTED
+                fails = _compile_failures.get(key, 0) + 1
+                _bounded_put(_compile_failures, key, fails)
+                if fails >= 2:
+                    _cache[key] = _UNSUPPORTED
                 stats["unsupported"] += 1
                 return None
             stats["compiles"] += 1
             _cache[key] = entry
+            # a clean compile clears the strike counter: only CONSECUTIVE
+            # failures exile a plan (transient tunnel drops must not
+            # accumulate across the cache's lifetime)
+            _compile_failures.pop(key, None)
         else:
             stats["hits"] += 1
             _cache.move_to_end(key)

@@ -234,6 +234,186 @@ def push_filters(rel: RelNode) -> RelNode:
 
 
 # ---------------------------------------------------------------------------
+# pass: connectivity-based join reordering
+# ---------------------------------------------------------------------------
+
+def reorder_joins(rel: RelNode) -> RelNode:
+    """Reorder INNER/CROSS join chains so every step has a join predicate.
+
+    The binder lowers a comma FROM list to a left-deep cross-product chain
+    and relies on filter pushdown to recover equi joins — which fails when
+    two FROM neighbours only connect through a later table (TPC-H Q9:
+    ``part, supplier, lineitem, ...`` — part and supplier both join
+    lineitem, not each other), leaving a true cross product. Calcite's
+    planner has the same weakness in the reference's rule list (no
+    JoinCommute/LoptOptimize there either), but its users write ANSI JOINs;
+    our oracle suite uses comma syntax heavily.
+
+    Only chains where the given order actually strands a step without a
+    connecting predicate are rewritten (greedy: next leaf in FROM order
+    connected to the joined prefix, equi predicates preferred); otherwise
+    the plan is left exactly as written.
+    """
+    # match Filter(chain) / bare chain BEFORE the generic recursion: the
+    # rewrite must see the filter's conjunct pool together with the intact
+    # chain (recursing first would rebuild the chain under a Project and
+    # hide it from the filter-level match); leaf subtrees are recursed
+    # through the rewritten node's inputs afterwards
+    out = None
+    if isinstance(rel, LogicalFilter) and isinstance(rel.input, LogicalJoin):
+        out = _reorder_chain(rel.input, _split_conjuncts(rel.condition))
+    elif isinstance(rel, LogicalJoin):
+        out = _reorder_chain(rel, [])
+    if out is not None:
+        chain, leftover = out
+        new: RelNode = chain
+        if leftover:
+            new = LogicalFilter(input=chain, condition=_and_all(leftover),
+                                schema=chain.schema)
+        return new.with_inputs([reorder_joins(i) for i in new.inputs])
+    if rel.inputs:
+        rel = rel.with_inputs([reorder_joins(i) for i in rel.inputs])
+    return rel
+
+
+def _reorder_chain(root: LogicalJoin, filt_conjuncts: List[RexNode]):
+    """Returns (new_rel, leftover_filter_conjuncts) or None to keep as-is."""
+    if root.join_type not in ("INNER", "CROSS"):
+        return None
+    leaves: List[Tuple[int, RelNode]] = []   # (global offset, leaf)
+    pool: List[RexNode] = []                 # conjuncts in global ordinals
+
+    def flat(j: RelNode, base: int) -> int:
+        if isinstance(j, LogicalJoin) and j.join_type in ("INNER", "CROSS"):
+            lw = flat(j.left, base)
+            rw = flat(j.right, base + lw)
+            if j.condition is not None and not (
+                    isinstance(j.condition, RexLiteral)
+                    and j.condition.value is True):
+                for cj in _split_conjuncts(j.condition):
+                    pool.append(remap_rex(
+                        cj, {i: base + i for i in rex_inputs(cj)}))
+            return lw + rw
+        leaves.append((base, j))
+        return len(j.schema)
+
+    total = flat(root, 0)
+    if len(leaves) < 3:
+        return None
+
+    leaf_of: Dict[int, int] = {}
+    for li, (off, leaf) in enumerate(leaves):
+        for o in range(off, off + len(leaf.schema)):
+            leaf_of[o] = li
+
+    def leafset(c: RexNode) -> Set[int]:
+        return {leaf_of[r] for r in rex_inputs(c)}
+
+    def is_equi(c: RexNode) -> bool:
+        return isinstance(c, RexCall) and c.op == "="
+
+    # connectors: pure multi-leaf conjuncts from join conditions AND the
+    # filter above; single-leaf/impure filter conjuncts stay behind for
+    # push_filters
+    cand = pool + [c for c in filt_conjuncts if _is_pure(c)]
+    connectors = [(c, leafset(c)) for c in cand if len(leafset(c)) >= 2]
+    if not connectors:
+        return None
+
+    def count_stranded(seq: List[int]) -> int:
+        joined: Set[int] = {seq[0]}
+        bad = 0
+        for li in seq[1:]:
+            if not any(li in ls and (ls - {li}) <= joined
+                       for _, ls in connectors):
+                bad += 1
+            joined.add(li)
+        return bad
+
+    orig_stranded = count_stranded(list(range(len(leaves))))
+    if orig_stranded == 0:
+        return None
+
+    # greedy order: prefer an equi-connected leaf (FROM order), then any
+    # connected leaf, then fall back to a genuine cross step
+    order = [0]
+    joined = {0}
+    remaining = list(range(1, len(leaves)))
+    while remaining:
+        pick = None
+        for want_equi in (True, False):
+            for li in remaining:
+                for c, ls in connectors:
+                    if (li in ls and (ls - {li}) <= joined
+                            and (is_equi(c) or not want_equi)):
+                        pick = li
+                        break
+                if pick is not None:
+                    break
+            if pick is not None:
+                break
+        if pick is None:
+            pick = remaining[0]
+        order.append(pick)
+        joined.add(pick)
+        remaining.remove(pick)
+
+    # rewrite only on STRICT improvement: an equally-stranded reorder would
+    # re-trigger on its own output forever (a genuinely unconnected pair
+    # stays a cross join no matter the order)
+    if count_stranded(order) >= orig_stranded:
+        return None
+
+    # ordinal mapping old-global -> new-global
+    old_to_new: Dict[int, int] = {}
+    new_off = 0
+    for li in order:
+        off, leaf = leaves[li]
+        for k in range(len(leaf.schema)):
+            old_to_new[off + k] = new_off + k
+        new_off += len(leaf.schema)
+
+    # build the left-deep tree, attaching each connector at the first step
+    # where all its leaves are available
+    placed = [False] * len(connectors)
+    single = [c for c in pool if len(leafset(c)) < 2]
+    acc = leaves[order[0]][1]
+    covered = {order[0]}
+    for li in order[1:]:
+        covered.add(li)
+        conds = []
+        for ci, (c, ls) in enumerate(connectors):
+            if not placed[ci] and ls <= covered:
+                placed[ci] = True
+                conds.append(remap_rex(c, {o: old_to_new[o]
+                                           for o in rex_inputs(c)}))
+        leaf = leaves[li][1]
+        schema = list(acc.schema) + list(leaf.schema)
+        acc = LogicalJoin(left=acc, right=leaf,
+                          join_type="INNER" if conds else "CROSS",
+                          condition=_and_all(conds), schema=schema)
+
+    # restore the original column order for the parent
+    orig_fields: List[Field] = []
+    for off, leaf in leaves:
+        orig_fields.extend(leaf.schema)
+    exprs = [RexInputRef(old_to_new[o], orig_fields[o].stype)
+             for o in range(total)]
+    proj = LogicalProject(input=acc, exprs=exprs, schema=orig_fields)
+
+    # leftovers: consumed filter connectors disappear from the filter;
+    # single-leaf join-condition conjuncts rejoin the filter pool (they
+    # were inside join conditions, now remapped to the original ordinals
+    # the filter namespace uses — which ARE the original global ordinals)
+    used_filter = {id(c) for (c, ls), p in zip(connectors, placed)
+                   if p and any(c is fc for fc in filt_conjuncts)}
+    leftover = [c for c in filt_conjuncts
+                if id(c) not in used_filter]
+    leftover.extend(single)
+    return proj, leftover
+
+
+# ---------------------------------------------------------------------------
 # pass: extract equi conditions from join residuals into the condition
 # (JOIN_CONDITION_PUSH is implicit: our executor splits equi pairs itself)
 # ---------------------------------------------------------------------------
@@ -458,8 +638,8 @@ def factor_or_predicates(rel: RelNode) -> RelNode:
     return rel
 
 
-PASSES = [merge_filters, factor_or_predicates, push_filters, merge_filters,
-          merge_projects]
+PASSES = [merge_filters, factor_or_predicates, reorder_joins, push_filters,
+          merge_filters, merge_projects]
 
 
 def optimize(plan: RelNode, enable_pruning: bool = True) -> RelNode:

@@ -134,6 +134,25 @@ def test_runtime_fallback_nonunique_build(c):
 
 
 @_needs_compiled
+def test_semi_join_heavy_duplicate_build(c):
+    # a SEMI join build side with one key repeated 200x: duplicates are
+    # legal for SEMI/ANTI and the merge join must handle them in-program
+    # (the carried build row has the same raw key), with no runtime fallback
+    import numpy as np
+    big = pd.DataFrame({"k": np.r_[np.full(200, 7), np.arange(50)].astype(np.int64)})
+    probe = pd.DataFrame({"k": np.arange(20).astype(np.int64)})
+    c.create_table("bucket_build", big)
+    c.create_table("bucket_probe", probe)
+    fb = compiled.stats["fallbacks"]
+    comp, eager = _both_paths(
+        c, "SELECT k FROM bucket_probe WHERE k IN (SELECT k FROM bucket_build)")
+    _assert_same(comp, eager, ordered=False)
+    assert compiled.stats["fallbacks"] == fb
+    c.drop_table("bucket_build")
+    c.drop_table("bucket_probe")
+
+
+@_needs_compiled
 def test_unsupported_plan_falls_back(c):
     # LAG reads its offset constant on the host: outside the compiled subset
     uns = compiled.stats["unsupported"]
