@@ -58,7 +58,7 @@ _CACHE_LIMIT = 128
 _DENY_OPS = {"RAND", "RAND_INTEGER"}
 
 stats = {"compiles": 0, "hits": 0, "fallbacks": 0, "unsupported": 0,
-         "recompiles": 0}
+         "recompiles": 0, "compile_errors": 0}
 
 
 class Unsupported(Exception):
@@ -258,11 +258,14 @@ def _group_sorted_codes(key_cols: List[Column],
     """
     from ..ops import sorted_agg as sa
 
+    from ..ops.pallas_kernels import _on_tpu
+
     n = len(key_cols[0])
     parts = _key_parts(key_cols)
     invalid = jnp.zeros(n, dtype=bool) if row_valid is None else ~row_valid
+    on_tpu = _on_tpu()
     n_operands = sum(2 if flag is not None else 1 for _, flag in parts)
-    hashed = n_operands > 2
+    hashed = on_tpu and n_operands > 2
 
     # key operands, most significant first (invalid rows last; within a
     # part the class flag outranks the data: NULL first, NaN last)
@@ -275,31 +278,40 @@ def _group_sorted_codes(key_cols: List[Column],
                 key_ops.append(flag)
             key_ops.append(d)
 
-    part_pay: List[jax.Array] = []
-    if hashed:
-        for d, flag in parts:
-            part_pay.append(d)
-            if flag is not None:
-                part_pay.append(flag)
-
-    nk = len(key_ops)
-    iota = jnp.arange(n, dtype=jnp.int64)
-    outs = jax.lax.sort(tuple(key_ops) + (iota,) + tuple(part_pay)
-                        + tuple(payload), num_keys=nk, is_stable=True)
-    perm = outs[nk]
-    valid_sorted = ~outs[0]
-    payload_sorted = outs[nk + 1 + len(part_pay):]
-
-    # adjacent-difference boundaries over the sorted key parts — no gathers
-    if hashed:
-        it = iter(outs[nk + 1: nk + 1 + len(part_pay)])
-        parts_sorted = [(next(it), next(it) if flag is not None else None)
-                        for _, flag in parts]
+    if not on_tpu:
+        # CPU/GPU: XLA's variadic comparator sort is slow there and random
+        # gathers are cheap — sort keys only, gather everything after
+        perm = jnp.lexsort(tuple(reversed(key_ops)))
+        valid_sorted = ~invalid[perm]
+        payload_sorted = tuple(p[perm] for p in payload)
+        parts_sorted = [(d[perm], None if flag is None else flag[perm])
+                        for d, flag in parts]
     else:
-        it = iter(outs[1:nk])
-        parts_sorted = [((next(it) if flag is not None else None), next(it))
-                        for _, flag in parts]
-        parts_sorted = [(d, f) for f, d in parts_sorted]
+        part_pay: List[jax.Array] = []
+        if hashed:
+            for d, flag in parts:
+                part_pay.append(d)
+                if flag is not None:
+                    part_pay.append(flag)
+
+        nk = len(key_ops)
+        iota = jnp.arange(n, dtype=jnp.int64)
+        outs = jax.lax.sort(tuple(key_ops) + (iota,) + tuple(part_pay)
+                            + tuple(payload), num_keys=nk, is_stable=True)
+        perm = outs[nk]
+        valid_sorted = ~outs[0]
+        payload_sorted = outs[nk + 1 + len(part_pay):]
+
+        if hashed:
+            it = iter(outs[nk + 1: nk + 1 + len(part_pay)])
+            parts_sorted = [(next(it),
+                             next(it) if flag is not None else None)
+                            for _, flag in parts]
+        else:
+            it = iter(outs[1:nk])
+            parts_sorted = [((next(it) if flag is not None else None),
+                             next(it)) for _, flag in parts]
+            parts_sorted = [(d, f) for f, d in parts_sorted]
     diff = jnp.zeros(n - 1, dtype=bool) if n > 1 else jnp.zeros(0, dtype=bool)
     for d, flag in parts_sorted:
         diff = diff | (d[1:] != d[:-1])
@@ -807,13 +819,68 @@ class _Tracer:
         ph = _hash_parts(pparts, pvalid)
         bh = _hash_parts(bparts, bvalid)
 
-        # --- merge join: ONE stable sort of the concatenated hash streams
-        # with payload channels, an associative "last build row" carry scan,
-        # and one unsort keyed on the original position. Zero probe-length
-        # random gathers: on TPU a single n-element gather costs ~2x a whole
-        # extra sort operand (profiled: 32ms gather vs 7ms u64 argsort at
-        # 1.8M rows), and the old probe did one gather per verify part plus
-        # one per build output column.
+        from ..ops.pallas_kernels import _on_tpu
+        if _on_tpu():
+            match, gathered = self._join_merge(jt, probe, build, pparts,
+                                               bparts, pvalid, ph, bh)
+        else:
+            # CPU/GPU: random gathers are cheap and associative_scan lowers
+            # poorly on XLA:CPU — the classic sorted probe wins there
+            match, gathered = self._join_probe_gather(jt, probe, build,
+                                                      pparts, bparts,
+                                                      pvalid, ph, bh)
+
+        if jt == "SEMI":
+            return _VT(probe.table.with_names(out_names),
+                       probe.vmask() & match)
+        if jt == "ANTI":
+            return _VT(probe.table.with_names(out_names),
+                       probe.vmask() & ~match)
+
+        if jt in ("LEFT", "RIGHT"):
+            gathered = [c.with_mask(c.valid_mask() & match) for c in gathered]
+        if probe_is_left:
+            cols = list(probe.table.columns) + gathered
+        else:
+            cols = gathered + list(probe.table.columns)
+        pairs = Table(out_names, cols)
+
+        if jt == "INNER":
+            valid = probe.vmask() & match
+            if residual:
+                pred = evaluate_predicate(_and_rex(residual), pairs, None)
+                if isinstance(pred, bool):
+                    pred = jnp.full(pairs.num_rows, pred)
+                valid = valid & pred
+            return _VT(pairs, valid)
+        # LEFT/RIGHT: every (valid) probe row survives
+        return _VT(pairs, probe.valid)
+
+    def _append_join_flags(self, jt, adj: jax.Array, raw_diffs) -> None:
+        """Shared fallback policy for both join strategies. ``adj`` marks
+        adjacent equal-hash build pairs in build-hash-sorted order;
+        ``raw_diffs`` are the matching adjacent raw-key inequality masks.
+        INNER/LEFT/RIGHT require a unique build key (adjacency of any kind
+        covers hash collisions too); SEMI/ANTI tolerate duplicates, so only
+        a genuine collision (equal hash, different raw key) is fatal."""
+        if jt in ("INNER", "LEFT", "RIGHT"):
+            self.fallback.append(adj.any())
+        else:
+            coll = jnp.zeros((), dtype=bool)
+            for d in raw_diffs:
+                coll = coll | (adj & d).any()
+            self.fallback.append(coll)
+
+    def _join_merge(self, jt, probe: _VT, build: _VT, pparts, bparts,
+                    pvalid: jax.Array, ph: jax.Array, bh: jax.Array):
+        """Merge join: ONE stable sort of the concatenated hash streams with
+        payload channels, an associative "last build row" carry scan, and one
+        unsort keyed on the original position. Zero probe-length random
+        gathers: on TPU a single n-element gather costs ~2x a whole extra
+        sort operand (profiled: 32ms gather vs 7ms u64 argsort at 1.8M
+        rows), and the gather probe pays one per verify part plus one per
+        build output column. Returns (match over probe rows, carried build
+        columns or None for SEMI/ANTI)."""
         nb, npr = build.n, probe.n
         m = nb + npr
         h_m = jnp.concatenate([bh, ph])
@@ -842,15 +909,7 @@ class _Tracer:
         # before same-hash probe rows), so duplicates/collisions show up as
         # adjacent build pairs — no scan needed for the flags
         adj = fbs[1:] & fbs[:-1] & (hs[1:] == hs[:-1]) & (hs[1:] != _U64_MAX)
-        if jt in ("INNER", "LEFT", "RIGHT"):
-            # build side must be unique on the key (covers hash collisions too)
-            self.fallback.append(adj.any())
-        else:
-            # duplicates fine for SEMI/ANTI; only hash collisions are fatal
-            coll = jnp.zeros((), dtype=bool)
-            for r in raws:
-                coll = coll | (adj & (r[1:] != r[:-1])).any()
-            self.fallback.append(coll)
+        self._append_join_flags(jt, adj, [r[1:] != r[:-1] for r in raws])
 
         def carry_op(a, b):
             take = b[0]
@@ -875,37 +934,40 @@ class _Tracer:
         match = un[1][nb:] & pvalid
         ub_cols = [o[nb:] for o in un[2:]]
 
-        if jt == "SEMI":
-            return _VT(probe.table.with_names(out_names),
-                       probe.vmask() & match)
-        if jt == "ANTI":
-            return _VT(probe.table.with_names(out_names),
-                       probe.vmask() & ~match)
-
+        if not need_cols:
+            return match, None
         gathered: List[Column] = []
         it = iter(ub_cols)
         for c0 in build.table.columns:
             data = next(it)
             mask = next(it) if c0.mask is not None else None
             gathered.append(Column(data, c0.stype, mask, c0.dictionary))
-        if jt in ("LEFT", "RIGHT"):
-            gathered = [c.with_mask(c.valid_mask() & match) for c in gathered]
-        if probe_is_left:
-            cols = list(probe.table.columns) + gathered
-        else:
-            cols = gathered + list(probe.table.columns)
-        pairs = Table(out_names, cols)
+        return match, gathered
 
-        if jt == "INNER":
-            valid = probe.vmask() & match
-            if residual:
-                pred = evaluate_predicate(_and_rex(residual), pairs, None)
-                if isinstance(pred, bool):
-                    pred = jnp.full(pairs.num_rows, pred)
-                valid = valid & pred
-            return _VT(pairs, valid)
-        # LEFT/RIGHT: every (valid) probe row survives
-        return _VT(pairs, probe.valid)
+    def _join_probe_gather(self, jt, probe: _VT, build: _VT, pparts, bparts,
+                           pvalid: jax.Array, ph: jax.Array, bh: jax.Array):
+        """Classic sorted-hash probe: argsort the build hashes, binary-search
+        each probe hash (searchsorted sorts probe+build together under XLA),
+        then gather the candidate row for verification and output columns.
+        Preferred off-TPU, where random gathers are cheap."""
+        nb = build.n
+        order = jnp.argsort(bh)
+        bh_sorted = bh[order]
+        adj = (bh_sorted[1:] == bh_sorted[:-1]) & (bh_sorted[1:] != _U64_MAX)
+        self._append_join_flags(
+            jt, adj,
+            [raw[order][1:] != raw[order][:-1] for _, raw in bparts])
+
+        pos = jnp.searchsorted(bh_sorted, ph, side="left", method="sort")
+        in_range = pos < nb
+        pos_c = jnp.minimum(pos, nb - 1)
+        cand = order[pos_c]
+        match = in_range & pvalid & (bh_sorted[pos_c] == ph)
+        for (_, praw), (_, braw) in zip(pparts, bparts):
+            match = match & (praw == braw[cand])
+        if jt in ("SEMI", "ANTI"):
+            return match, None
+        return match, [c.take(cand) for c in build.table.columns]
 
 
 
@@ -1140,7 +1202,10 @@ def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
                 _bounded_put(_compile_failures, key, fails)
                 if fails >= 2:
                     _cache[key] = _UNSUPPORTED
-                stats["unsupported"] += 1
+                    stats["unsupported"] += 1
+                else:
+                    # first strike may be transient — not exiled (yet)
+                    stats["compile_errors"] += 1
                 return None
             stats["compiles"] += 1
             _cache[key] = entry

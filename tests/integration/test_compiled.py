@@ -134,22 +134,32 @@ def test_runtime_fallback_nonunique_build(c):
 
 
 @_needs_compiled
-def test_semi_join_heavy_duplicate_build(c):
+@pytest.mark.parametrize("strategy", ["merge", "gather"])
+def test_semi_join_heavy_duplicate_build(c, strategy, monkeypatch):
     # a SEMI join build side with one key repeated 200x: duplicates are
-    # legal for SEMI/ANTI and the merge join must handle them in-program
-    # (the carried build row has the same raw key), with no runtime fallback
+    # legal for SEMI/ANTI and BOTH join strategies must handle them
+    # in-program (merge: the carried build row has the same raw key;
+    # gather: the leftmost equal-hash candidate does), with no runtime
+    # fallback. The merge path is TPU-preferred, so force it explicitly —
+    # off-TPU the default would quietly test only the gather path.
     import numpy as np
+    from dask_sql_tpu.ops import pallas_kernels
+    monkeypatch.setattr(pallas_kernels, "_on_tpu",
+                        lambda: strategy == "merge")
     big = pd.DataFrame({"k": np.r_[np.full(200, 7), np.arange(50)].astype(np.int64)})
     probe = pd.DataFrame({"k": np.arange(20).astype(np.int64)})
-    c.create_table("bucket_build", big)
-    c.create_table("bucket_probe", probe)
+    # strategy-specific table names: the compiled-program cache keys on the
+    # plan, and a cache hit would silently reuse the other strategy's program
+    c.create_table(f"bucket_build_{strategy}", big)
+    c.create_table(f"bucket_probe_{strategy}", probe)
     fb = compiled.stats["fallbacks"]
     comp, eager = _both_paths(
-        c, "SELECT k FROM bucket_probe WHERE k IN (SELECT k FROM bucket_build)")
+        c, f"SELECT k FROM bucket_probe_{strategy} WHERE k IN "
+           f"(SELECT k FROM bucket_build_{strategy})")
     _assert_same(comp, eager, ordered=False)
     assert compiled.stats["fallbacks"] == fb
-    c.drop_table("bucket_build")
-    c.drop_table("bucket_probe")
+    c.drop_table(f"bucket_build_{strategy}")
+    c.drop_table(f"bucket_probe_{strategy}")
 
 
 @_needs_compiled
