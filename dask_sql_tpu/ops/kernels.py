@@ -351,18 +351,3 @@ def append_lexsort_operands(arrays: list, parts) -> None:
             arrays.append(flag)
 
 
-def part_boundaries(parts, perm: jax.Array) -> jax.Array:
-    """Boundary mask over the permuted stream: True where any key part (data
-    or class flag) differs from the previous row. Row 0 is always True.
-    The single definition both GROUP BY factorization and window
-    partitioning rely on — they must agree on group equality."""
-    n = perm.shape[0]
-    boundary = jnp.zeros(n, dtype=bool).at[0].set(True)
-    for d, flag in parts:
-        ds = d[perm]
-        diff = ds[1:] != ds[:-1]
-        if flag is not None:
-            fs = flag[perm]
-            diff = diff | (fs[1:] != fs[:-1])
-        boundary = boundary | jnp.concatenate([jnp.ones(1, bool), diff])
-    return boundary

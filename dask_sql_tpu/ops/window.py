@@ -22,8 +22,7 @@ import numpy as np
 
 from ..table import dict_sort_order, Column, Scalar, Table
 from ..types import SqlType, physical_dtype
-from .kernels import (append_lexsort_operands, comparable_data,
-                      key_parts, part_boundaries)
+from .kernels import (append_lexsort_operands, comparable_data, key_parts)
 
 # window ops whose kernels are fully trace-safe (the compiled executor's
 # supported subset; the rest read host constants)
@@ -45,6 +44,18 @@ def _segment_starts(codes_sorted: jax.Array) -> jax.Array:
 
 def _segment_ids(starts: jax.Array) -> jax.Array:
     return jnp.cumsum(starts.astype(jnp.int64)) - 1
+
+
+def _adjacent_diff(channels, n: int) -> jax.Array:
+    """Row 0 True; row i True iff ANY channel differs from row i-1.
+    Channels are already sorted streams — boundary detection without
+    post-sort gathers (group equality == equality of every sort channel)."""
+    if n == 0:
+        return jnp.zeros(0, dtype=bool)
+    diff = jnp.zeros(n - 1, dtype=bool)
+    for ch in channels:
+        diff = diff | (ch[1:] != ch[:-1])
+    return jnp.concatenate([jnp.ones(1, dtype=bool), diff])
 
 
 def segmented_cumsum(x: jax.Array, starts: jax.Array) -> jax.Array:
@@ -102,8 +113,14 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
     if n == 0:
         return Column(jnp.zeros(0, dtype=physical_dtype(stype)), stype)
 
+    from .pallas_kernels import _on_tpu
+    on_tpu = _on_tpu()
+
     # 1. sort by (validity, partition, order keys) — trace-safe: partitions
-    # come from key-part comparisons, not a factorize
+    # come from key-part comparisons, not a factorize. Arrays are built
+    # least-significant-first (jnp.lexsort order); the argument column rides
+    # the sort as a payload operand on TPU, where a random n-element gather
+    # costs ~2x a whole extra sort operand (profiled on the join path).
     arrays = []
     for idx, asc, nulls_first in reversed(order_keys):
         col = table.columns[idx]
@@ -118,20 +135,51 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
             arrays.append(nullkey if not nulls_first else -nullkey)
         else:
             arrays.append(data)
+    n_ord_ops = len(arrays)
     part_parts = key_parts([table.columns[i] for i in partition_cols]) \
         if partition_cols else []
     append_lexsort_operands(arrays, list(reversed(part_parts)))
     if row_valid is not None:
         arrays.append((~row_valid).astype(jnp.int8))  # invalid rows last
-    perm = jnp.lexsort(arrays) if arrays else jnp.arange(n)
-    inv_perm = jnp.argsort(perm)  # scatter-free inverse
 
-    # 2. segment starts from sorted partition-part diffs (+ validity edge)
-    starts = part_boundaries(part_parts, perm)
-    if row_valid is not None:
-        vs = row_valid[perm]
-        starts = starts | jnp.concatenate(
-            [jnp.ones(1, bool), vs[1:] != vs[:-1]])
+    pay: List[jax.Array] = []
+    arg_slot = None
+    arg_col0 = table.columns[arg_cols[0]] if arg_cols else None
+    if arg_col0 is not None and op != "NTILE":
+        arg_slot = (len(pay), arg_col0.mask is not None)
+        pay.append(arg_col0.data)
+        if arg_col0.mask is not None:
+            pay.append(arg_col0.mask)
+
+    keys_msf = list(reversed(arrays))  # most significant first
+    if not keys_msf:
+        perm = jnp.arange(n)
+        keys_sorted: List[jax.Array] = []
+        pay_sorted = list(pay)
+    elif on_tpu:
+        iota = jnp.arange(n, dtype=jnp.int64)
+        outs = jax.lax.sort(tuple(keys_msf) + (iota,) + tuple(pay),
+                            num_keys=len(keys_msf), is_stable=True)
+        perm = outs[len(keys_msf)]
+        keys_sorted = list(outs[:len(keys_msf)])
+        pay_sorted = list(outs[len(keys_msf) + 1:])
+    else:
+        perm = jnp.lexsort(tuple(arrays))
+        keys_sorted = [k[perm] for k in keys_msf]
+        pay_sorted = [p[perm] for p in pay]
+
+    def sorted_arg() -> Column:
+        di, has_mask = arg_slot
+        return Column(pay_sorted[di], arg_col0.stype,
+                      pay_sorted[di + 1] if has_mask else None,
+                      arg_col0.dictionary)
+
+    # 2. segment starts from adjacent diffs over the SORTED partition (and
+    # validity) channels — no gathers; tie groups reuse the order channels
+    n_seg_ops = len(keys_msf) - n_ord_ops
+    starts = _adjacent_diff(keys_sorted[:n_seg_ops], n)
+    tie = _adjacent_diff(keys_sorted[n_seg_ops:], n) & ~starts \
+        if order_keys else jnp.zeros(n, dtype=bool)
     pos = jnp.arange(n)
     # per-row segment bounds via forward/backward segmented scans
     seg_start = segmented_scan(pos, starts, jnp.minimum)
@@ -145,8 +193,18 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
     lo_off, hi_off = _frame_offsets(op, frame, bool(order_keys))
 
     def scatter_back(sorted_vals, mask_sorted=None):
-        out = sorted_vals[inv_perm]
-        m = None if mask_sorted is None else mask_sorted[inv_perm]
+        # un-sort to original row order: payload sort on TPU, argsort +
+        # gather elsewhere (mirrors the join/groupby backend split)
+        if on_tpu:
+            chs = ((perm, sorted_vals) if mask_sorted is None
+                   else (perm, sorted_vals, mask_sorted))
+            outs2 = jax.lax.sort(chs, num_keys=1)
+            out = outs2[1]
+            m = outs2[2] if mask_sorted is not None else None
+        else:
+            inv_perm = jnp.argsort(perm)
+            out = sorted_vals[inv_perm]
+            m = None if mask_sorted is None else mask_sorted[inv_perm]
         return Column(out.astype(physical_dtype(stype)) if not stype.is_string else out,
                       stype, m)
 
@@ -154,7 +212,6 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
         return scatter_back(row_in_seg + 1)
 
     if op in ("RANK", "DENSE_RANK", "PERCENT_RANK", "CUME_DIST"):
-        tie = _tie_starts(table, order_keys, perm, starts)
         # rank = position of the first row of the current tie group:
         # propagate the last tie/segment start forward within the segment
         tie_start = segmented_scan(jnp.where(tie | starts, pos, -1), starts,
@@ -191,7 +248,7 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
         src = pos + shift
         valid = (src >= seg_start) & (src <= seg_end)
         src = jnp.clip(src, 0, n - 1)
-        sorted_col = col.take(perm)
+        sorted_col = sorted_arg()
         gathered = sorted_col.take(src)
         m = gathered.valid_mask() & valid
         out = scatter_back(gathered.data, m)
@@ -200,7 +257,7 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
         return out
 
     if op in ("FIRST_VALUE", "LAST_VALUE", "NTH_VALUE"):
-        col = table.columns[arg_cols[0]].take(perm)
+        col = sorted_arg()
         if op == "FIRST_VALUE":
             src = seg_start
         elif op == "LAST_VALUE":
@@ -223,7 +280,7 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
     # aggregate window functions
     if op in ("COUNT",):
         if arg_cols:
-            col = table.columns[arg_cols[0]].take(perm)
+            col = sorted_arg()
             x = col.valid_mask().astype(jnp.int64)
         else:
             x = jnp.ones(n, dtype=jnp.int64)
@@ -231,7 +288,7 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
         return scatter_back(out)
 
     if op in ("SUM", "$SUM0", "AVG"):
-        col = table.columns[arg_cols[0]].take(perm)
+        col = sorted_arg()
         valid = col.valid_mask()
         data = jnp.where(valid, col.data, 0)
         if jnp.issubdtype(data.dtype, jnp.integer):
@@ -249,7 +306,7 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
         return scatter_back(s, (c > 0))
 
     if op in ("MIN", "MAX"):
-        col = table.columns[arg_cols[0]].take(perm)
+        col = sorted_arg()
         valid = col.valid_mask()
         data = comparable_data(col)
         if jnp.issubdtype(data.dtype, jnp.integer):
@@ -320,7 +377,7 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
         return scatter_back(out, m)
 
     if op == "SINGLE_VALUE":
-        col = table.columns[arg_cols[0]].take(perm)
+        col = sorted_arg()
         src = seg_start
         g = col.take(src)
         out = scatter_back(g.data, g.mask)
@@ -362,24 +419,6 @@ def _frame_offsets(op: str, frame, has_order: bool):
     if lo[0] == "UNBOUNDED_FOLLOWING":
         lo_v = None
     return lo_v, hi_v
-
-
-def _tie_starts(table: Table, order_keys, perm, starts) -> jax.Array:
-    """True where the order-key value differs from the previous sorted row."""
-    n = int(perm.shape[0])
-    if not order_keys or n == 0:
-        return jnp.zeros(n, dtype=bool)
-    diff = jnp.zeros(n, dtype=bool)
-    for idx, _, _ in order_keys:
-        col = table.columns[idx]
-        data = comparable_data(col)[perm]
-        d = jnp.concatenate([jnp.zeros(1, bool), data[1:] != data[:-1]])
-        if col.mask is not None:
-            m = col.mask[perm]
-            dm = jnp.concatenate([jnp.zeros(1, bool), m[1:] != m[:-1]])
-            d = d | dm
-        diff = diff | d
-    return diff & ~starts
 
 
 def _backward_fill_positions(pos, is_last, seg_end):

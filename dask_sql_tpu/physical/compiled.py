@@ -954,9 +954,9 @@ class _Tracer:
         order = jnp.argsort(bh)
         bh_sorted = bh[order]
         adj = (bh_sorted[1:] == bh_sorted[:-1]) & (bh_sorted[1:] != _U64_MAX)
+        raws_sorted = [raw[order] for _, raw in bparts]
         self._append_join_flags(
-            jt, adj,
-            [raw[order][1:] != raw[order][:-1] for _, raw in bparts])
+            jt, adj, [rs[1:] != rs[:-1] for rs in raws_sorted])
 
         pos = jnp.searchsorted(bh_sorted, ph, side="left", method="sort")
         in_range = pos < nb

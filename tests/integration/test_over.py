@@ -170,3 +170,22 @@ def test_bounded_minmax_frames_vs_bruteforce(c):
                     assert got.mx[i] == window.max(), (lo, hi, p, i)
                 else:
                     assert pd.isna(got.mn[i]), (lo, hi, p, i)
+
+
+def test_window_tpu_sort_payload_branch(c, user_table_1, monkeypatch):
+    # force the TPU payload-through-sort branch of compute_window off-TPU:
+    # same results must come out of both backends' sort/unsort strategies.
+    # DSQL_COMPILE=0 keeps both runs on the eager path — the compiled-plan
+    # cache would otherwise replay the first run's program for the second
+    monkeypatch.setenv("DSQL_COMPILE", "0")
+    from dask_sql_tpu.ops import pallas_kernels
+    q = ("SELECT user_id, b, "
+         "SUM(b) OVER (PARTITION BY user_id ORDER BY b) AS s, "
+         "RANK() OVER (PARTITION BY user_id ORDER BY b) AS r "
+         "FROM user_table_1")
+    base = c.sql(q, return_futures=False).sort_values(
+        ["user_id", "b"], ignore_index=True)
+    monkeypatch.setattr(pallas_kernels, "_on_tpu", lambda: True)
+    forced = c.sql(q, return_futures=False).sort_values(
+        ["user_id", "b"], ignore_index=True)
+    pd.testing.assert_frame_equal(base, forced)
