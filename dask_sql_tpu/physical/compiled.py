@@ -69,7 +69,7 @@ class Unsupported(Exception):
 # fingerprinting
 # ---------------------------------------------------------------------------
 
-def _fp_rex(rex: RexNode) -> str:
+def _fp_rex(rex: RexNode, context=None, scans=None) -> str:
     if isinstance(rex, RexInputRef):
         return f"@{rex.index}"
     if isinstance(rex, RexLiteral):
@@ -81,7 +81,15 @@ def _fp_rex(rex: RexNode) -> str:
         info = getattr(rex, "info", None)
         if info is not None:
             extra = f"!{getattr(info, 'name', info)}"
-        return (f"C{rex.op}{extra}[" + ",".join(_fp_rex(o) for o in rex.operands)
+        return (f"C{rex.op}{extra}["
+                + ",".join(_fp_rex(o, context, scans) for o in rex.operands)
+                + f"]:{rex.stype.name}")
+    from ..plan.nodes import RexScalarSubquery
+    if isinstance(rex, RexScalarSubquery) and context is not None:
+        # uncorrelated scalar subquery: the subplan joins the cache key and
+        # its scans join the input spec; the tracer inlines it as a
+        # broadcast 1-row result
+        return ("S[" + _fp_plan(rex.plan, context, scans)
                 + f"]:{rex.stype.name}")
     raise Unsupported(type(rex).__name__)
 
@@ -101,23 +109,32 @@ def _fp_plan(rel: RelNode, context, scans: list) -> str:
         rv = "+rv" if entry.row_valid is not None else ""
         return f"Scan({rel.schema_name}.{rel.table_name}{rv})[{schema}]"
     if isinstance(rel, LogicalProject):
-        body = ",".join(_fp_rex(e) for e in rel.exprs)
+        body = ",".join(_fp_rex(e, context, scans) for e in rel.exprs)
     elif isinstance(rel, LogicalFilter):
-        body = _fp_rex(rel.condition)
+        body = _fp_rex(rel.condition, context, scans)
     elif isinstance(rel, LogicalAggregate):
         for agg in rel.aggs:
-            if agg.udaf is not None or agg.distinct:
-                raise Unsupported("udaf/distinct agg")
+            if agg.udaf is not None:
+                raise Unsupported("udaf agg")
+            if agg.distinct and (
+                    agg.op not in ("COUNT", "SUM", "$SUM0", "AVG",
+                                   "MIN", "MAX")
+                    or agg.filter_arg is not None or not agg.args):
+                # FILTER + DISTINCT: the first occurrence of a value may be
+                # filtered away while a later duplicate passes — the
+                # first-occurrence dedup mask would undercount
+                raise Unsupported("distinct agg shape")
             if agg.op in ("LISTAGG", "BIT_AND", "BIT_OR", "BIT_XOR"):
                 raise Unsupported(agg.op)
         body = (f"g={rel.group_keys}|" + ",".join(
-            f"{a.op}({a.args})f{a.filter_arg}" for a in rel.aggs))
+            f"{a.op}{'d' if a.distinct else ''}({a.args})f{a.filter_arg}"
+            for a in rel.aggs))
     elif isinstance(rel, LogicalJoin):
         if rel.join_type not in ("INNER", "LEFT", "RIGHT", "SEMI", "ANTI"):
             raise Unsupported(rel.join_type)
         if getattr(rel, "null_aware", False):
             raise Unsupported("null-aware anti join")
-        cond = "T" if rel.condition is None else _fp_rex(rel.condition)
+        cond = ("T" if rel.condition is None else _fp_rex(rel.condition, context, scans))
         body = f"{rel.join_type}|{cond}"
     elif isinstance(rel, LogicalSort):
         body = (",".join(f"{c.index}{'a' if c.ascending else 'd'}"
@@ -470,6 +487,8 @@ def _keys_valid(cols: List[Column], row_valid: Optional[jax.Array]) -> jax.Array
 # ---------------------------------------------------------------------------
 
 class _Tracer:
+    is_tracer = True   # routes RexScalarSubquery into traced_scalar_subquery
+
     def __init__(self, context, scan_tables: Dict[tuple, Table],
                  caps: Dict[str, int]):
         self.context = context
@@ -479,6 +498,30 @@ class _Tracer:
         self.ngroups: List[jax.Array] = []        # device ints, order = walk
         self.ngroup_caps: List[int] = []          # matching static caps
         self._agg_counter = 0
+
+    def traced_scalar_subquery(self, rex, outer_table: Table) -> Column:
+        """Inline an uncorrelated scalar subquery into this trace.
+
+        Only statically-1-row subplans qualify (an ungrouped aggregate, or
+        projections over one); anything with a runtime row count can't
+        deliver SQL's 0-rows->NULL / >1-rows->error semantics in-program.
+        The single value broadcasts to the outer table's length so NULL-ness
+        rides the validity mask like any other column."""
+        vt = self.run(rex.plan)
+        if vt.valid is not None or vt.n != 1:
+            raise Unsupported("scalar subquery with runtime row count")
+        col = vt.table.columns[0]
+        n = outer_table.num_rows
+        d0 = col.data[0]
+        data = jnp.broadcast_to(d0, (n,))
+        valid0 = None if col.mask is None else col.mask[0]
+        if jnp.issubdtype(col.data.dtype, jnp.floating):
+            # the eager path coerces a NaN subquery result to NULL
+            # (evaluate.py _eval_scalar_subquery); match it
+            notnan = ~jnp.isnan(d0)
+            valid0 = notnan if valid0 is None else (valid0 & notnan)
+        mask = None if valid0 is None else jnp.broadcast_to(valid0, (n,))
+        return Column(data, col.stype, mask, col.dictionary)
 
     # -- dispatch ----------------------------------------------------------
     def run(self, rel: RelNode) -> _VT:
@@ -499,7 +542,7 @@ class _Tracer:
         src = self.run(rel.input)
         cols: List[Column] = []
         for rex, f in zip(rel.exprs, rel.schema):
-            v = evaluate_rex(rex, src.table, None)
+            v = evaluate_rex(rex, src.table, self)
             if isinstance(v, Scalar):
                 v = Column.from_scalar(v, src.n)
             cols.append(v)
@@ -507,7 +550,7 @@ class _Tracer:
 
     def _LogicalFilter(self, rel: LogicalFilter) -> _VT:
         src = self.run(rel.input)
-        mask = evaluate_predicate(rel.condition, src.table, None)
+        mask = evaluate_predicate(rel.condition, src.table, self)
         if isinstance(mask, bool):
             if mask:
                 return src
@@ -530,6 +573,9 @@ class _Tracer:
                 f = rel.schema[j]
                 col = src.table.columns[agg.args[0]] if agg.args else None
                 fmask = self._agg_filter(agg, src)
+                if agg.distinct and agg.op not in ("MIN", "MAX"):
+                    keep = self._distinct_keep([], agg, src)
+                    fmask = keep if fmask is None else (fmask & keep)
                 out_cols.append(G.whole_table_aggregate(
                     agg.op, col, fmask, f.stype, n))
             return _VT(Table(out_names, out_cols), None)
@@ -567,6 +613,16 @@ class _Tracer:
                 payload.append(col.mask)
             pay_slots[idx] = (di, mi)
 
+        # DISTINCT dedup masks: computed once per argument column and shipped
+        # through the group sort as payload (not gathered by perm afterwards)
+        keep_slots: Dict[int, int] = {}
+        for agg in rel.aggs:
+            if agg.distinct and agg.op not in ("MIN", "MAX"):
+                ai = agg.args[0]
+                if ai not in keep_slots:
+                    keep_slots[ai] = len(payload)
+                    payload.append(self._distinct_keep(key_cols, agg, src))
+
         gs = _group_sorted_codes(key_cols, src.valid, cap, tuple(payload))
         self.fallback.append(gs.collision)
         self.ngroups.append(gs.num_groups)
@@ -591,6 +647,10 @@ class _Tracer:
             if agg.filter_arg is not None:
                 fc = _sorted_col(agg.filter_arg)
                 vmask = vmask & fc.data.astype(bool) & fc.valid_mask()
+            if agg.distinct and agg.op not in ("MIN", "MAX"):
+                # DISTINCT: only each (group keys, value) pair's first
+                # occurrence contributes (MIN/MAX are dedup-invariant)
+                vmask = vmask & gs.payload_sorted[keep_slots[agg.args[0]]]
             out_cols.append(G.sorted_segment_aggregate(
                 agg.op, col_s, vmask, gs.codes_sorted, gs.starts, gs.ends,
                 f.stype))
@@ -618,7 +678,7 @@ class _Tracer:
             return None
         for agg in rel.aggs:
             col = src.table.columns[agg.args[0]] if agg.args else None
-            if agg.op not in ("SUM", "$SUM0", "AVG", "COUNT"):
+            if agg.op not in ("SUM", "$SUM0", "AVG", "COUNT") or agg.distinct:
                 return None
             if col is not None and not jnp.issubdtype(col.data.dtype,
                                                       jnp.floating):
@@ -673,6 +733,16 @@ class _Tracer:
                                     f.stype, has)
         out_cols.extend(results)
         return _VT(Table(out_names, out_cols), occupancy)
+
+    def _distinct_keep(self, key_cols: List[Column], agg, src: _VT
+                       ) -> jax.Array:
+        """Row-space mask marking the first occurrence of each
+        (group keys, argument value) combination among valid rows."""
+        n = src.n
+        dk = list(key_cols) + [src.table.columns[agg.args[0]]]
+        codes, first, _, coll = _traced_factorize(dk, src.valid, n)
+        self.fallback.append(coll)
+        return jnp.clip(first, 0, max(n - 1, 0))[codes] == jnp.arange(n)
 
     def _agg_filter(self, agg, src: _VT):
         """Combined FILTER-clause + row-validity mask (None = all rows)."""
@@ -784,8 +854,11 @@ class _Tracer:
         jt = rel.join_type
         if not equi:
             raise Unsupported("non-equi/cross join")
-        if residual and jt != "INNER":
-            raise Unsupported("outer join with residual")
+        if residual and jt in ("SEMI", "ANTI"):
+            # existence must consider the residual per candidate PAIR; with a
+            # duplicate-friendly build side a single carried candidate can't
+            # decide it in-program
+            raise Unsupported("semi/anti join with residual")
 
         lk = [k for k, _ in equi]
         rk = [k for _, k in equi]
@@ -837,24 +910,29 @@ class _Tracer:
             return _VT(probe.table.with_names(out_names),
                        probe.vmask() & ~match)
 
-        if jt in ("LEFT", "RIGHT"):
-            gathered = [c.with_mask(c.valid_mask() & match) for c in gathered]
-        if probe_is_left:
-            cols = list(probe.table.columns) + gathered
-        else:
-            cols = gathered + list(probe.table.columns)
-        pairs = Table(out_names, cols)
+        def _pairs(build_cols: List[Column]) -> Table:
+            if probe_is_left:
+                return Table(out_names,
+                             list(probe.table.columns) + build_cols)
+            return Table(out_names, build_cols + list(probe.table.columns))
+
+        if residual:
+            # ON-clause residual: evaluated on the candidate pair (real
+            # probe values + the carried build candidate's values); where
+            # the equi key already failed, the AND with match discards the
+            # garbage verdict
+            pred = evaluate_predicate(_and_rex(residual), _pairs(gathered),
+                                      self)
+            if isinstance(pred, bool):
+                pred = jnp.full(probe.n, pred)
+            match = match & pred
 
         if jt == "INNER":
-            valid = probe.vmask() & match
-            if residual:
-                pred = evaluate_predicate(_and_rex(residual), pairs, None)
-                if isinstance(pred, bool):
-                    pred = jnp.full(pairs.num_rows, pred)
-                valid = valid & pred
-            return _VT(pairs, valid)
-        # LEFT/RIGHT: every (valid) probe row survives
-        return _VT(pairs, probe.valid)
+            return _VT(_pairs(gathered), probe.vmask() & match)
+        # LEFT/RIGHT: every (valid) probe row survives; the build side is
+        # NULL wherever the full ON condition (equi + residual) failed
+        gathered = [c.with_mask(c.valid_mask() & match) for c in gathered]
+        return _VT(_pairs(gathered), probe.valid)
 
     def _append_join_flags(self, jt, adj: jax.Array, raw_diffs) -> None:
         """Shared fallback policy for both join strategies. ``adj`` marks

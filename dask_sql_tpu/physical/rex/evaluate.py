@@ -51,6 +51,11 @@ def _eval_call(rex: RexCall, table: Table, executor):
 
 
 def _eval_scalar_subquery(rex: RexScalarSubquery, table: Table, executor):
+    if getattr(executor, "is_tracer", False):
+        # compiled mode: inline the subplan into the same trace; the result
+        # broadcasts to a full-length column (NULL-ness must stay a traced
+        # mask — Scalar's host-checked ``value is None`` can't carry it)
+        return executor.traced_scalar_subquery(rex, table)
     sub = executor.execute(rex.plan)
     if sub.num_rows == 0:
         return Scalar(None, rex.stype)

@@ -638,13 +638,44 @@ def factor_or_predicates(rel: RelNode) -> RelNode:
     return rel
 
 
-PASSES = [merge_filters, factor_or_predicates, reorder_joins, push_filters,
-          merge_filters, merge_projects]
+# push_filters runs BEFORE reorder_joins: sinking filter equalities into
+# join conditions first both repairs chains that need no reordering (TPC-H
+# Q17: the equi predicate lives two filters above the non-equi join) and
+# feeds the reorder pass a complete connector pool via the join conditions
+# it flattens; a second push sinks the reorder's leftover conjuncts
+PASSES = [merge_filters, factor_or_predicates, push_filters, merge_filters,
+          reorder_joins, push_filters, merge_filters, merge_projects]
+
+
+def optimize_subplans(rel: RelNode) -> RelNode:
+    """Recursively optimize plans embedded in scalar-subquery expressions —
+    the tree passes only walk ``rel.inputs``, so a HAVING/WHERE subquery's
+    own join chain would otherwise reach the executor unoptimized (TPC-H
+    Q11: a 3-table comma list inside HAVING stays a cross product)."""
+
+    def walk_rex(r: RexNode) -> None:
+        if isinstance(r, RexScalarSubquery):
+            r.plan = optimize(r.plan)
+        elif isinstance(r, RexCall):
+            for o in r.operands:
+                walk_rex(o)
+
+    if rel.inputs:
+        rel = rel.with_inputs([optimize_subplans(i) for i in rel.inputs])
+    if isinstance(rel, LogicalProject):
+        for e in rel.exprs:
+            walk_rex(e)
+    elif isinstance(rel, LogicalFilter):
+        walk_rex(rel.condition)
+    elif isinstance(rel, LogicalJoin) and rel.condition is not None:
+        walk_rex(rel.condition)
+    return rel
 
 
 def optimize(plan: RelNode, enable_pruning: bool = True) -> RelNode:
     for p in PASSES:
         plan = p(plan)
+    plan = optimize_subplans(plan)
     if enable_pruning:
         plan = prune_columns(plan)
         plan = merge_projects(plan)

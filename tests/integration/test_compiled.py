@@ -213,3 +213,39 @@ def test_desc_sort_nan_last_both_paths(c):
     import numpy as np
     assert np.isnan(comp["r"].iloc[-1]) and np.isnan(eager["r"].iloc[-1])
     _assert_same(comp, eager, ordered=True)
+
+
+@_needs_compiled
+def test_distinct_aggregate_compiles(c, user_table_1):
+    before = compiled.stats["compiles"] + compiled.stats["hits"]
+    comp, eager = _both_paths(
+        c, "SELECT user_id, COUNT(DISTINCT b) AS n, SUM(DISTINCT b) AS s "
+           "FROM user_table_1 GROUP BY user_id")
+    _assert_same(comp, eager, ordered=False)
+    assert compiled.stats["compiles"] + compiled.stats["hits"] == before + 1
+    comp, eager = _both_paths(
+        c, "SELECT COUNT(DISTINCT b) AS n FROM user_table_1")
+    _assert_same(comp, eager, ordered=True)
+
+
+@_needs_compiled
+def test_scalar_subquery_compiles(c, user_table_1):
+    before = compiled.stats["compiles"] + compiled.stats["hits"]
+    comp, eager = _both_paths(
+        c, "SELECT user_id, b FROM user_table_1 "
+           "WHERE b > (SELECT AVG(b) FROM user_table_1)")
+    _assert_same(comp, eager, ordered=False)
+    assert compiled.stats["compiles"] + compiled.stats["hits"] == before + 1
+
+
+@_needs_compiled
+def test_left_join_residual_compiles(c, user_table_1, user_table_2):
+    # LEFT JOIN with a non-equi ON conjunct: the residual must knock out
+    # pairs (NULL build side) without dropping probe rows
+    before = compiled.stats["compiles"] + compiled.stats["hits"]
+    comp, eager = _both_paths(
+        c, "SELECT u2.user_id, u2.c, u1.b FROM user_table_2 u2 "
+           "LEFT JOIN user_table_1 u1 "
+           "ON u2.user_id = u1.user_id AND u1.b > 1")
+    _assert_same(comp, eager, ordered=False)
+    assert compiled.stats["compiles"] + compiled.stats["hits"] == before + 1
