@@ -734,15 +734,21 @@ class _Tracer:
         out_cols.extend(results)
         return _VT(Table(out_names, out_cols), occupancy)
 
-    def _distinct_keep(self, key_cols: List[Column], agg, src: _VT
-                       ) -> jax.Array:
-        """Row-space mask marking the first occurrence of each
-        (group keys, argument value) combination among valid rows."""
-        n = src.n
-        dk = list(key_cols) + [src.table.columns[agg.args[0]]]
-        codes, first, _, coll = _traced_factorize(dk, src.valid, n)
+    def _first_occurrence_keep(self, cols: List[Column],
+                               row_valid: Optional[jax.Array]) -> jax.Array:
+        """Row-space mask: True on the first valid row of each distinct
+        column-tuple (the shared dedup primitive for UNION DISTINCT and
+        DISTINCT aggregates). Appends the factorize collision flag."""
+        n = len(cols[0])
+        codes, first, _, coll = _traced_factorize(cols, row_valid, n)
         self.fallback.append(coll)
         return jnp.clip(first, 0, max(n - 1, 0))[codes] == jnp.arange(n)
+
+    def _distinct_keep(self, key_cols: List[Column], agg, src: _VT
+                       ) -> jax.Array:
+        """First occurrence of each (group keys, argument value) combo."""
+        return self._first_occurrence_keep(
+            list(key_cols) + [src.table.columns[agg.args[0]]], src.valid)
 
     def _agg_filter(self, agg, src: _VT):
         """Combined FILTER-clause + row-validity mask (None = all rows)."""
@@ -838,13 +844,9 @@ class _Tracer:
         if rel.all:
             return out
         # UNION DISTINCT: keep first occurrence of each distinct row
-        n = out.n
-        codes, first, _, collision = _traced_factorize(
-            list(out.table.columns), out.valid, n)
-        self.fallback.append(collision)
-        keep = jnp.clip(first, 0, n - 1)[codes] == jnp.arange(n)
-        keep = keep & out.vmask()
-        return _VT(out.table, keep)
+        keep = self._first_occurrence_keep(list(out.table.columns),
+                                           out.valid)
+        return _VT(out.table, keep & out.vmask())
 
     def _LogicalJoin(self, rel: LogicalJoin) -> _VT:
         from .rel.executor import _and_rex, _extract_equi_keys
@@ -854,11 +856,6 @@ class _Tracer:
         jt = rel.join_type
         if not equi:
             raise Unsupported("non-equi/cross join")
-        if residual and jt in ("SEMI", "ANTI"):
-            # existence must consider the residual per candidate PAIR; with a
-            # duplicate-friendly build side a single carried candidate can't
-            # decide it in-program
-            raise Unsupported("semi/anti join with residual")
 
         lk = [k for k, _ in equi]
         rk = [k for _, k in equi]
@@ -887,6 +884,20 @@ class _Tracer:
         else:
             bparts, pparts = _join_key_parts(bk_cols, pk_cols)
 
+        exist_test = None
+        if residual and jt in ("SEMI", "ANTI"):
+            # a single carried candidate can't decide a per-PAIR residual,
+            # but one of the form  build.x OP probe.y  (OP comparison) only
+            # needs per-key build aggregates: exists x<>y <=> cnt>0 and
+            # (min!=y or max!=y); exists x<y <=> min<y; etc. (TPC-H Q21's
+            # NOT EXISTS .. l3.l_suppkey <> l1.l_suppkey). Anything else —
+            # or float operands, whose NaN comparison semantics the
+            # min/max reduction can't reproduce — stays eager.
+            exist_test = self._residual_exist_test(rel, residual, probe,
+                                                   build)
+            if exist_test is None:
+                raise Unsupported("semi/anti join with general residual")
+
         pvalid = _keys_valid(pk_cols, probe.valid)
         bvalid = _keys_valid(bk_cols, build.valid)
         ph = _hash_parts(pparts, pvalid)
@@ -895,10 +906,13 @@ class _Tracer:
         from ..ops.pallas_kernels import _on_tpu
         if _on_tpu():
             match, gathered = self._join_merge(jt, probe, build, pparts,
-                                               bparts, pvalid, ph, bh)
+                                               bparts, pvalid, ph, bh,
+                                               exist_test)
         else:
             # CPU/GPU: random gathers are cheap and associative_scan lowers
             # poorly on XLA:CPU — the classic sorted probe wins there
+            if exist_test is not None:
+                raise Unsupported("semi/anti residual needs the merge join")
             match, gathered = self._join_probe_gather(jt, probe, build,
                                                       pparts, bparts,
                                                       pvalid, ph, bh)
@@ -949,8 +963,42 @@ class _Tracer:
                 coll = coll | (adj & d).any()
             self.fallback.append(coll)
 
+    def _residual_exist_test(self, rel, residual, probe: _VT, build: _VT):
+        """(op, x build Column, y probe Column) for a residual of the form
+        ``build.x OP probe.y`` with OP a comparison; None otherwise.
+        ``op`` is normalized so the test reads "exists build x with x OP y".
+        Floats are excluded (NaN comparison semantics don't survive the
+        min/max reduction)."""
+        if len(residual) != 1:
+            return None
+        r = residual[0]
+        if not (isinstance(r, RexCall) and r.op in ("<>", "<", "<=", ">", ">=")
+                and len(r.operands) == 2
+                and all(isinstance(o, RexInputRef) for o in r.operands)):
+            return None
+        nl = len(rel.left.schema)  # probe IS the left side for SEMI/ANTI
+        a, b = r.operands
+        if a.index < nl <= b.index:      # pred = y OP x -> exists x SWAP(OP) y
+            y_col = probe.table.columns[a.index]
+            x_col = build.table.columns[b.index - nl]
+            op = {"<": ">", ">": "<", "<=": ">=", ">=": "<=", "<>": "<>"}[r.op]
+        elif b.index < nl <= a.index:    # pred = x OP y
+            x_col = build.table.columns[a.index - nl]
+            y_col = probe.table.columns[b.index]
+            op = r.op
+        else:
+            return None
+        if x_col.stype.is_string != y_col.stype.is_string:
+            return None
+        for c in (x_col, y_col):
+            if not c.stype.is_string and jnp.issubdtype(c.data.dtype,
+                                                        jnp.floating):
+                return None
+        return op, x_col, y_col
+
     def _join_merge(self, jt, probe: _VT, build: _VT, pparts, bparts,
-                    pvalid: jax.Array, ph: jax.Array, bh: jax.Array):
+                    pvalid: jax.Array, ph: jax.Array, bh: jax.Array,
+                    exist_test=None):
         """Merge join: ONE stable sort of the concatenated hash streams with
         payload channels, an associative "last build row" carry scan, and one
         unsort keyed on the original position. Zero probe-length random
@@ -977,11 +1025,33 @@ class _Tracer:
                     col_ch.append(jnp.concatenate(
                         [c0.mask, jnp.zeros(npr, dtype=bool)]))
 
-        outs = jax.lax.sort((h_m, flag_b, iota_m, *raw_ch, *col_ch),
+        res_ch: List[jax.Array] = []
+        if exist_test is not None:
+            _, x_col, y_col = exist_test
+            if x_col.stype.is_string:
+                xd, yd = unify_string_codes([x_col, y_col])
+            else:
+                dt = jnp.promote_types(x_col.data.dtype, y_col.data.dtype)
+                xd = x_col.data.astype(dt)
+                yd = y_col.data.astype(dt)
+            xd, yd = xd.astype(jnp.int64), yd.astype(jnp.int64)
+            res_ch = [
+                jnp.concatenate([xd, jnp.zeros(npr, dtype=jnp.int64)]),
+                jnp.concatenate([x_col.valid_mask(),
+                                 jnp.zeros(npr, dtype=bool)]),
+                jnp.concatenate([jnp.zeros(nb, dtype=jnp.int64), yd]),
+                jnp.concatenate([jnp.zeros(nb, dtype=bool),
+                                 y_col.valid_mask()]),
+            ]
+
+        outs = jax.lax.sort((h_m, flag_b, iota_m, *raw_ch, *col_ch,
+                             *res_ch),
                             num_keys=1, is_stable=True)
         hs, fbs, iotas = outs[0], outs[1], outs[2]
         raws = outs[3:3 + len(raw_ch)]
-        colss = outs[3 + len(raw_ch):]
+        ncol = len(col_ch)
+        colss = outs[3 + len(raw_ch): 3 + len(raw_ch) + ncol]
+        ress = outs[3 + len(raw_ch) + ncol:]
 
         # equal-hash build rows are contiguous (stable sort puts build rows
         # before same-hash probe rows), so duplicates/collisions show up as
@@ -1007,6 +1077,34 @@ class _Tracer:
         match_s = (~fbs) & has_b
         for cr, r in zip(c_raws, raws):
             match_s = match_s & (cr == r)
+
+        if exist_test is not None:
+            # per-hash-run build aggregates decide "exists build x OP y":
+            # all build rows of a run precede its probe rows (stable sort),
+            # so a probe's inclusive segmented scan covers the whole run
+            from ..ops.window import segmented_cumsum, segmented_scan
+            op_t = exist_test[0]
+            xs, xvs, ys, yvs = ress
+            run_start = jnp.concatenate(
+                [jnp.ones(1, dtype=bool), hs[1:] != hs[:-1]])
+            xv = xvs & fbs
+            cnt = segmented_cumsum(xv.astype(jnp.int64), run_start)
+            mn = segmented_scan(jnp.where(xv, xs, jnp.iinfo(jnp.int64).max),
+                                run_start, jnp.minimum)
+            mx = segmented_scan(jnp.where(xv, xs, jnp.iinfo(jnp.int64).min),
+                                run_start, jnp.maximum)
+            has_x = cnt > 0
+            if op_t == "<>":
+                ex = (mn != ys) | (mx != ys)
+            elif op_t == "<":
+                ex = mn < ys
+            elif op_t == "<=":
+                ex = mn <= ys
+            elif op_t == ">":
+                ex = mx > ys
+            else:
+                ex = mx >= ys
+            match_s = match_s & has_x & ex & yvs
 
         un = jax.lax.sort((iotas, match_s, *c_cols), num_keys=1)
         match = un[1][nb:] & pvalid

@@ -643,8 +643,163 @@ def factor_or_predicates(rel: RelNode) -> RelNode:
 # Q17: the equi predicate lives two filters above the non-equi join) and
 # feeds the reorder pass a complete connector pool via the join conditions
 # it flattens; a second push sinks the reorder's leftover conjuncts
+
+
+def push_join_side_conditions(rel: RelNode) -> RelNode:
+    """Move single-side ON-clause conjuncts into the side they reference.
+
+    For LEFT joins a build-side-only conjunct filters the build input before
+    the join (identical semantics: it can only knock out matches, never probe
+    rows); probe-side-only conjuncts must STAY in the ON clause (they void
+    matches without dropping probe rows). INNER joins push both directions.
+    """
+    if rel.inputs:
+        rel = rel.with_inputs([push_join_side_conditions(i)
+                               for i in rel.inputs])
+    if not (isinstance(rel, LogicalJoin)
+            and rel.join_type in ("INNER", "LEFT", "RIGHT")
+            and rel.condition is not None):
+        return rel
+    nl = len(rel.left.schema)
+    left_ok = rel.join_type in ("INNER", "RIGHT")
+    right_ok = rel.join_type in ("INNER", "LEFT")
+    stay, to_left, to_right = [], [], []
+    for cj in _split_conjuncts(rel.condition):
+        refs = rex_inputs(cj)
+        if not _is_pure(cj) or not refs:
+            stay.append(cj)
+        elif all(r < nl for r in refs) and left_ok:
+            to_left.append(cj)
+        elif all(r >= nl for r in refs) and right_ok:
+            to_right.append(cj)
+        else:
+            stay.append(cj)
+    if not to_left and not to_right:
+        return rel
+    new_left, new_right = rel.left, rel.right
+    if to_left:
+        new_left = LogicalFilter(input=rel.left,
+                                 condition=_and_all(to_left),
+                                 schema=rel.left.schema)
+    if to_right:
+        shifted = [remap_rex(cj, {i: i - nl for i in rex_inputs(cj)})
+                   for cj in to_right]
+        new_right = LogicalFilter(input=rel.right,
+                                  condition=_and_all(shifted),
+                                  schema=rel.right.schema)
+    cond = _and_all(stay) if stay else None
+    out = LogicalJoin(left=new_left, right=new_right,
+                      join_type=rel.join_type, condition=cond,
+                      schema=rel.schema)
+    if hasattr(rel, "null_aware"):
+        out.null_aware = rel.null_aware  # type: ignore[attr-defined]
+    return out
+
+
+_AGG_THROUGH_JOIN_OPS = {"COUNT", "SUM", "$SUM0", "MIN", "MAX"}
+
+
+def aggregate_through_join(rel: RelNode) -> RelNode:
+    """Pre-aggregate a join's right side when the aggregate only groups by
+    left-side columns and only aggregates right-side columns.
+
+    Turns the 1:N expansion of e.g. TPC-H Q13 (customer LEFT JOIN orders,
+    COUNT per customer) into a groupby on the N side + an N:1 join — which
+    the compiled executor's unique-build join handles, and which is
+    strictly less work everywhere (the join output never materializes the
+    multiplicity). Calcite ships the same family as
+    AggregateJoinTransposeRule; the reference's rule list only has the
+    REMOVE variant (RelationalAlgebraGenerator.java:206).
+    """
+    if rel.inputs:
+        rel = rel.with_inputs([aggregate_through_join(i) for i in rel.inputs])
+    if not isinstance(rel, LogicalAggregate):
+        return rel
+    join = rel.input
+    # look through a bare-ref projection (the binder's pre-projection)
+    remap: Optional[List[int]] = None
+    if (isinstance(join, LogicalProject)
+            and all(isinstance(e, RexInputRef) for e in join.exprs)):
+        remap = [e.index for e in join.exprs]
+        join = join.input
+    if not (isinstance(join, LogicalJoin)
+            and join.join_type in ("INNER", "LEFT")
+            and join.condition is not None):
+        return rel
+
+    def m(i: int) -> int:
+        return remap[i] if remap is not None else i
+
+    group_keys = [m(g) for g in rel.group_keys]
+    agg_args = [[m(a) for a in agg.args] for agg in rel.aggs]
+    nl = len(join.left.schema)
+    # equi keys must be bare column refs (they become the pre-agg group keys)
+    lkeys: List[int] = []
+    rkeys: List[int] = []
+    for cj in _split_conjuncts(join.condition):
+        if not (isinstance(cj, RexCall) and cj.op == "="
+                and len(cj.operands) == 2
+                and all(isinstance(o, RexInputRef) for o in cj.operands)):
+            return rel
+        a, b = cj.operands[0].index, cj.operands[1].index
+        if a < nl <= b:
+            lkeys.append(a); rkeys.append(b - nl)
+        elif b < nl <= a:
+            lkeys.append(b); rkeys.append(a - nl)
+        else:
+            return rel
+    if not lkeys:
+        return rel
+    if not all(g < nl for g in group_keys):
+        return rel
+    for agg, args in zip(rel.aggs, agg_args):
+        if (agg.op not in _AGG_THROUGH_JOIN_OPS or agg.distinct
+                or agg.udaf is not None or agg.filter_arg is not None
+                or not args or any(a < nl for a in args)):
+            return rel
+
+    # right pre-aggregate: group by the right join keys
+    pre_fields = [Field(f"$jk{i}", join.right.schema[k].stype)
+                  for i, k in enumerate(rkeys)]
+    pre_aggs: List[AggCall] = []
+    for i, (agg, args) in enumerate(zip(rel.aggs, agg_args)):
+        pre_aggs.append(AggCall(op=agg.op, args=[a - nl for a in args],
+                                distinct=False, stype=agg.stype,
+                                name=f"$pa{i}", filter_arg=None, udaf=None))
+        pre_fields.append(Field(f"$pa{i}", agg.stype))
+    pre = LogicalAggregate(input=join.right, group_keys=list(rkeys),
+                           aggs=pre_aggs, schema=pre_fields)
+
+    # rejoin: left columns keep their ordinals; right side is now the
+    # pre-aggregate (keys first, then one column per aggregate)
+    cond = None
+    for i, lk in enumerate(lkeys):
+        eq = RexCall("=", [RexInputRef(lk, join.left.schema[lk].stype),
+                           RexInputRef(nl + i, pre_fields[i].stype)],
+                     BOOLEAN)
+        cond = eq if cond is None else RexCall("AND", [cond, eq], BOOLEAN)
+    j_schema = list(join.left.schema) + pre_fields
+    j2 = LogicalJoin(left=join.left, right=pre, join_type=join.join_type,
+                     condition=cond, schema=j_schema)
+
+    # outer combine: COUNT -> $SUM0 of the (0-coalesced) partial counts,
+    # SUM/MIN/MAX recombine with themselves over the partials
+    out_aggs: List[AggCall] = []
+    for i, agg in enumerate(rel.aggs):
+        slot = nl + len(rkeys) + i
+        outer_op = "$SUM0" if agg.op == "COUNT" else agg.op
+        out_aggs.append(AggCall(op=outer_op, args=[slot], distinct=False,
+                                stype=agg.stype, name=agg.name,
+                                filter_arg=None, udaf=None))
+    agg2 = LogicalAggregate(input=j2, group_keys=list(group_keys),
+                            aggs=out_aggs, schema=rel.schema)
+    return agg2
+
+
 PASSES = [merge_filters, factor_or_predicates, push_filters, merge_filters,
-          reorder_joins, push_filters, merge_filters, merge_projects]
+          reorder_joins, push_filters, merge_filters,
+          push_join_side_conditions, push_filters, merge_filters,
+          aggregate_through_join, merge_projects]
 
 
 def optimize_subplans(rel: RelNode) -> RelNode:

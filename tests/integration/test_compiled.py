@@ -243,9 +243,33 @@ def test_left_join_residual_compiles(c, user_table_1, user_table_2):
     # LEFT JOIN with a non-equi ON conjunct: the residual must knock out
     # pairs (NULL build side) without dropping probe rows
     before = compiled.stats["compiles"] + compiled.stats["hits"]
+    # the cross-side conjunct survives push_join_side_conditions (a
+    # build-only one would be rewritten into a pre-join filter and never
+    # reach the compiled residual path)
     comp, eager = _both_paths(
         c, "SELECT u2.user_id, u2.c, u1.b FROM user_table_2 u2 "
            "LEFT JOIN user_table_1 u1 "
-           "ON u2.user_id = u1.user_id AND u1.b > 1")
+           "ON u2.user_id = u1.user_id AND u1.b > u2.user_id")
     _assert_same(comp, eager, ordered=False)
     assert compiled.stats["compiles"] + compiled.stats["hits"] == before + 1
+
+
+@_needs_compiled
+def test_anti_join_comparison_residual_compiles(c, monkeypatch):
+    # NOT EXISTS with a build-vs-probe comparison residual (TPC-H Q21's
+    # l3.l_suppkey <> l1.l_suppkey): per-hash-run build min/max/count decide
+    # existence in-program on the merge path
+    from dask_sql_tpu.ops import pallas_kernels
+    monkeypatch.setattr(pallas_kernels, "_on_tpu", lambda: True)
+    orders_df = pd.DataFrame({"ok": [1, 1, 1, 2, 2, 3],
+                              "sk": [10, 11, 10, 20, 20, 30]})
+    c.create_table("resid_li", orders_df)
+    before = compiled.stats["compiles"] + compiled.stats["hits"]
+    comp, eager = _both_paths(
+        c, "SELECT l1.ok, l1.sk FROM resid_li l1 WHERE NOT EXISTS ("
+           "SELECT * FROM resid_li l2 WHERE l2.ok = l1.ok AND l2.sk <> l1.sk)")
+    _assert_same(comp, eager, ordered=False)
+    # order 1 has two distinct suppliers -> excluded; orders 2,3 survive
+    assert sorted(comp.ok.unique().tolist()) == [2, 3]
+    assert compiled.stats["compiles"] + compiled.stats["hits"] == before + 1
+    c.drop_table("resid_li")
