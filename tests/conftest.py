@@ -257,3 +257,8 @@ def make_rand_df(size: int, **kwargs):
                 s.iloc[idx] = np.nan
         data[name] = s
     return pd.DataFrame(data)
+
+
+needs_compiled = pytest.mark.skipif(
+    os.environ.get("DSQL_COMPILE") == "0",
+    reason="asserts compiled-path usage; meaningless with DSQL_COMPILE=0")

@@ -15,13 +15,19 @@ import pytest
 
 from benchmarks.tpch import QUERIES, generate_tpch
 from dask_sql_tpu import Context
+from tests.conftest import needs_compiled
 
 SF = 0.003
 
 
 @pytest.fixture(scope="module")
-def tpch():
-    data = generate_tpch(SF)
+def tpch_data():
+    return generate_tpch(SF)
+
+
+@pytest.fixture(scope="module")
+def tpch(tpch_data):
+    data = tpch_data
     ctx = Context()
     conn = sqlite3.connect(":memory:")
     for name, df in data.items():
@@ -72,3 +78,28 @@ def test_tpch_query_matches_sqlite(tpch, qid):
         else:
             assert (gv.astype(str).to_numpy()
                     == wv.astype(str).to_numpy()).all(), f"Q{qid} col {col}"
+
+
+@needs_compiled
+def test_all_queries_use_compiled_path(tpch_data, monkeypatch):
+    """Every TPC-H query must run as ONE compiled program, no eager
+    fallbacks — the merge-join strategy is forced so the TPU path is what
+    gets pinned (the CPU gather strategy rejects Q21's anti-join residual
+    by design). A fresh Context is load-bearing: the program cache keys on
+    table identity, so reusing the oracle fixture's tables could replay
+    programs traced before the monkeypatch."""
+    from dask_sql_tpu.ops import pallas_kernels
+    from dask_sql_tpu.physical import compiled
+    monkeypatch.setattr(pallas_kernels, "_on_tpu", lambda: True)
+    data = tpch_data
+    ctx = Context()
+    for name, df in data.items():
+        ctx.create_table(name, df)
+    not_compiled = []
+    for qid in sorted(QUERIES):
+        s0 = dict(compiled.stats)
+        ctx.sql(QUERIES[qid], return_futures=False)
+        d = {k: compiled.stats[k] - s0[k] for k in s0}
+        if not (d["hits"] or d["compiles"]) or d["fallbacks"] or d["unsupported"]:
+            not_compiled.append((qid, d))
+    assert not not_compiled, f"queries off the compiled path: {not_compiled}"
