@@ -35,6 +35,16 @@ _CONTAINERS = [f"{a} {b}" for a in ("SM", "LG", "MED", "JUMBO", "WRAP")
 _D = lambda s: (pd.Timestamp(s) - pd.Timestamp("1970-01-01")).days  # noqa: E731
 
 
+def _tag(prefix: str, nums: np.ndarray, width: int) -> np.ndarray:
+    """Vectorized f"{prefix}{num:0{width}d}" (dbgen-style names); the
+    per-element Python loop dominated generation time at SF>=1."""
+    return (prefix + pd.Series(nums).astype(str).str.zfill(width)).to_numpy()
+
+
+def _blank(n: int) -> np.ndarray:
+    return np.full(n, "", dtype=object)
+
+
 def generate_tpch(sf: float = 0.01, seed: int = 0) -> dict:
     """Returns {table_name: pandas.DataFrame} for the 8 TPC-H tables."""
     rng = np.random.RandomState(seed)
@@ -56,25 +66,28 @@ def generate_tpch(sf: float = 0.01, seed: int = 0) -> dict:
     })
     supplier = pd.DataFrame({
         "s_suppkey": np.arange(1, n_supp + 1),
-        "s_name": [f"Supplier#{i:09d}" for i in range(1, n_supp + 1)],
-        "s_address": [f"addr{i}" for i in range(n_supp)],
+        "s_name": _tag("Supplier#", np.arange(1, n_supp + 1), 9),
+        "s_address": _tag("addr", np.arange(n_supp), 0),
         "s_nationkey": rng.randint(0, n_nation, n_supp),
-        "s_phone": [f"{i:010d}" for i in range(n_supp)],
+        "s_phone": _tag("", np.arange(n_supp), 10),
         "s_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_supp), 2),
-        "s_comment": ["" for _ in range(n_supp)],
+        "s_comment": _blank(n_supp),
     })
     part = pd.DataFrame({
         "p_partkey": np.arange(1, n_part + 1),
         "p_name": rng.choice(["ivory blue", "green navy", "red linen",
                               "metallic olive", "antique puff"], n_part),
-        "p_mfgr": [f"Manufacturer#{i % 5 + 1}" for i in range(n_part)],
-        "p_brand": [f"Brand#{i % 5 + 1}{i % 5 + 1}" for i in range(n_part)],
+        "p_mfgr": _tag("Manufacturer#", np.arange(n_part) % 5 + 1, 0),
+        # dbgen brands are "Brand#MN" with independent M,N in 1..5 — Q17/Q19
+        # filter on Brand#23/12/34, which must actually exist in the data
+        "p_brand": _tag("Brand#", (np.arange(n_part) % 5 + 1) * 10
+                        + (np.arange(n_part) // 5) % 5 + 1, 0),
         "p_type": rng.choice(_TYPES, n_part),
         "p_size": rng.randint(1, 51, n_part),
         "p_container": rng.choice(_CONTAINERS, n_part),
         "p_retailprice": np.round(900 + (np.arange(1, n_part + 1) % 1000) / 10.0
                                   + 100 * (np.arange(1, n_part + 1) % 10), 2),
-        "p_comment": ["" for _ in range(n_part)],
+        "p_comment": _blank(n_part),
     })
     n_ps = n_part * 4
     # dbgen invariant: (ps_partkey, ps_suppkey) is a primary key — each part
@@ -92,29 +105,39 @@ def generate_tpch(sf: float = 0.01, seed: int = 0) -> dict:
                              np.tile(np.arange(4), n_part)),
         "ps_availqty": rng.randint(1, 10_000, n_ps),
         "ps_supplycost": np.round(rng.uniform(1.0, 1000.0, n_ps), 2),
-        "ps_comment": ["" for _ in range(n_ps)],
+        "ps_comment": _blank(n_ps),
     })
+    c_nationkey = rng.randint(0, n_nation, n_cust)
     customer = pd.DataFrame({
         "c_custkey": np.arange(1, n_cust + 1),
-        "c_name": [f"Customer#{i:09d}" for i in range(1, n_cust + 1)],
-        "c_address": [f"addr{i}" for i in range(n_cust)],
-        "c_nationkey": rng.randint(0, n_nation, n_cust),
-        "c_phone": [f"{i:010d}" for i in range(n_cust)],
+        "c_name": _tag("Customer#", np.arange(1, n_cust + 1), 9),
+        "c_address": _tag("addr", np.arange(n_cust), 0),
+        "c_nationkey": c_nationkey,
+        # dbgen phones start with the country code nationkey+10 (10..34):
+        # Q22 filters SUBSTRING(c_phone,1,2) IN ('13','31',...) and must
+        # actually select customers
+        "c_phone": _tag(pd.Series(c_nationkey + 10).astype(str) + "-",
+                        np.arange(n_cust), 8),
         "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_cust), 2),
         "c_mktsegment": rng.choice(_SEGMENTS, n_cust),
-        "c_comment": ["" for _ in range(n_cust)],
+        "c_comment": _blank(n_cust),
     })
     o_dates = rng.randint(_D("1992-01-01"), _D("1998-08-02"), n_ord)
+    # dbgen: customers with custkey % 3 == 0 never place orders — Q22's
+    # NOT EXISTS(orders) anti-join needs a real population to select
+    o_custkey = rng.randint(1, n_cust + 1, n_ord)
+    o_custkey = o_custkey + (o_custkey % 3 == 0)
+    o_custkey = np.where(o_custkey > n_cust, 1, o_custkey)
     orders = pd.DataFrame({
         "o_orderkey": np.arange(1, n_ord + 1) * 4,  # dbgen sparse keys
-        "o_custkey": rng.randint(1, n_cust + 1, n_ord),
+        "o_custkey": o_custkey,
         "o_orderstatus": rng.choice(["F", "O", "P"], n_ord, p=[0.49, 0.49, 0.02]),
         "o_totalprice": np.round(rng.uniform(800.0, 600_000.0, n_ord), 2),
         "o_orderdate": pd.to_datetime(o_dates, unit="D"),
         "o_orderpriority": rng.choice(_PRIORITIES, n_ord),
-        "o_clerk": [f"Clerk#{i % 1000:09d}" for i in range(n_ord)],
+        "o_clerk": _tag("Clerk#", np.arange(n_ord) % 1000, 9),
         "o_shippriority": np.zeros(n_ord, dtype=np.int64),
-        "o_comment": ["" for _ in range(n_ord)],
+        "o_comment": _blank(n_ord),
     })
     lines_per_order = rng.randint(1, 8, n_ord)
     n_li = int(lines_per_order.sum())
@@ -130,7 +153,7 @@ def generate_tpch(sf: float = 0.01, seed: int = 0) -> dict:
         "l_orderkey": li_order,
         "l_partkey": (li_partkey := rng.randint(1, n_part + 1, n_li)),
         "l_suppkey": _psupp(li_partkey, rng.randint(0, 4, n_li)),
-        "l_linenumber": np.concatenate([np.arange(1, k + 1) for k in lines_per_order]),
+        "l_linenumber": np.arange(n_li) - np.repeat(np.cumsum(lines_per_order) - lines_per_order, lines_per_order) + 1,
         "l_quantity": rng.randint(1, 51, n_li).astype(np.float64),
         "l_extendedprice": np.round(rng.uniform(900.0, 105_000.0, n_li), 2),
         "l_discount": np.round(rng.randint(0, 11, n_li) / 100.0, 2),
@@ -142,7 +165,7 @@ def generate_tpch(sf: float = 0.01, seed: int = 0) -> dict:
         "l_receiptdate": pd.to_datetime(receipt, unit="D"),
         "l_shipinstruct": rng.choice(_INSTRUCTS, n_li),
         "l_shipmode": rng.choice(_SHIPMODES, n_li),
-        "l_comment": ["" for _ in range(n_li)],
+        "l_comment": _blank(n_li),
     })
     return {
         "region": region, "nation": nation, "supplier": supplier,

@@ -8,8 +8,9 @@ plan is traced ONCE into a single jitted program with *static shapes* —
 filters keep rows and flip a validity mask instead of compacting, GROUP BY
 factorizes via an in-trace lexsort with a static group-capacity bound, and
 equi-joins probe a sorted build side via ``searchsorted`` — then the program
-is cached keyed by (plan fingerprint, input table identity/shape). Steady
-state is ONE device dispatch + one tiny flags transfer per query.
+is cached keyed by (plan fingerprint, input shapes/dtypes + string-dictionary
+content). Steady state is ONE device dispatch + one tiny flags transfer per
+query, and reloading fresh data with the same layout never recompiles.
 
 Runtime conditions XLA cannot express statically (group-count overflow,
 non-unique build side, 64-bit hash collision) surface through a flags vector;
@@ -24,8 +25,10 @@ scheduler" design of SURVEY §5.
 """
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
+import weakref
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
@@ -59,6 +62,10 @@ _DENY_OPS = {"RAND", "RAND_INTEGER"}
 
 stats = {"compiles": 0, "hits": 0, "fallbacks": 0, "unsupported": 0,
          "recompiles": 0, "compile_errors": 0}
+
+# build-side payload channels (data + masks) above which the merge join's
+# extra sort operands cost more than the probe path's gathers (ADVICE r1)
+_MERGE_BUILD_WIDTH = int(os.environ.get("DSQL_MERGE_BUILD_WIDTH", "32"))
 
 
 class Unsupported(Exception):
@@ -162,16 +169,46 @@ def _fp_plan(rel: RelNode, context, scans: list) -> str:
     return f"{t}({body})[{schema}]<{kids}>"
 
 
+_dict_fp_memo: Dict[int, tuple] = {}
+
+
+def _dict_fingerprint(arr) -> str:
+    """Content hash of a string dictionary, memoized per array object.
+
+    String dictionaries are embedded in the jitted program as constants, so
+    they must join the cache key — but by CONTENT, not object identity:
+    reloading the same data (new Table, equal dictionaries) must hit the
+    cached program instead of recompiling.
+    """
+    key = id(arr)
+    hit = _dict_fp_memo.get(key)
+    if hit is not None and hit[0]() is arr:
+        return hit[1]
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str(len(arr)).encode())
+    for s in arr:
+        b = str(s).encode()
+        # length prefix, not a separator: elements may contain any byte, so
+        # a separator could make ["a\0", "b"] and ["a", "\0b"] collide
+        h.update(str(len(b)).encode() + b":" + b)
+    fp = h.hexdigest()
+    _dict_fp_memo[key] = (
+        weakref.ref(arr, lambda _r, k=key: _dict_fp_memo.pop(k, None)), fp)
+    return fp
+
+
 def _fp_inputs(scans: list) -> tuple:
     out = []
     for _, tbl, row_valid in scans:
+        # keyed on shapes/dtypes + dictionary CONTENT (not table identity):
+        # new data with the same layout reuses the compiled program; any
+        # dictionary change reshapes the key because the dictionaries are
+        # baked into the program as constants
         cols = tuple(
-            (c.data.shape, str(c.data.dtype), c.mask is not None)
+            (c.data.shape, str(c.data.dtype), c.mask is not None,
+             None if c.dictionary is None else _dict_fingerprint(c.dictionary))
             for c in tbl.columns)
-        # tbl.uid is monotonic and never reused (unlike id()), so a cache
-        # hit implies the exact Table traced against — including the string
-        # dictionaries embedded in the jitted program as constants
-        out.append((tbl.uid, cols, row_valid is not None))
+        out.append((cols, row_valid is not None))
     return tuple(out)
 
 
@@ -904,7 +941,17 @@ class _Tracer:
         bh = _hash_parts(bparts, bvalid)
 
         from ..ops.pallas_kernels import _on_tpu
-        if _on_tpu():
+        # The merge join ships every build column (data + mask) as a sort
+        # payload channel; past a width cutoff the per-channel O(n log n)
+        # sort cost overtakes the probe path's per-column O(n) gathers even
+        # on TPU, so very wide build sides fall back to the gather strategy.
+        # (SEMI/ANTI carry no build columns, so the exist-test residual —
+        # which only the merge join supports — is never affected.)
+        build_width = 0
+        if jt in ("INNER", "LEFT", "RIGHT"):
+            build_width = sum(1 + (c.mask is not None)
+                              for c in build.table.columns)
+        if _on_tpu() and build_width <= _MERGE_BUILD_WIDTH:
             match, gathered = self._join_merge(jt, probe, build, pparts,
                                                bparts, pvalid, ph, bh,
                                                exist_test)
@@ -993,6 +1040,14 @@ class _Tracer:
         for c in (x_col, y_col):
             if not c.stype.is_string and jnp.issubdtype(c.data.dtype,
                                                         jnp.floating):
+                return None
+        if not x_col.stype.is_string:
+            # the min/max reduction runs in int64: uint64 values >= 2^63
+            # would wrap on the cast and invert the ordering, and a MIXED
+            # uint64/signed pair promotes to float64 (lossy above 2^53) —
+            # only pairs whose promotion stays a signed integer are safe
+            dt = jnp.promote_types(x_col.data.dtype, y_col.data.dtype)
+            if dt == jnp.uint64 or jnp.issubdtype(dt, jnp.floating):
                 return None
         return op, x_col, y_col
 
@@ -1338,8 +1393,18 @@ def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
         logger.debug("not compilable: %s", e)
         stats["unsupported"] += 1
         return None
-    base_key = (plan_fp, _fp_inputs(scans))
-    if base_key in _runtime_eager:
+    from ..ops.pallas_kernels import _on_tpu
+    # the backend joins the key: tracing picks backend-specific strategies
+    # (merge vs gather join), and with content-based input fingerprints a
+    # program — or an _UNSUPPORTED verdict — traced for one backend could
+    # otherwise replay on another
+    base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()))
+    # runtime verdicts (non-unique build keys, hash collisions) depend on
+    # NUMERIC data the layout fingerprint cannot see, so they are pinned to
+    # the exact Tables via uid — a reload with corrected data must get a
+    # fresh chance at the compiled path, not inherit the old dataset's exile
+    runtime_key = (base_key, tuple(t.uid for _, t, _ in scans))
+    if runtime_key in _runtime_eager:
         stats["fallbacks"] += 1
         return None
     caps: Dict[str, int] = dict(_learned_caps.get(base_key, {}))
@@ -1402,8 +1467,8 @@ def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
             continue
         if result is None:
             # runtime invariant failed (non-unique build / hash collision):
-            # data is keyed into base_key, so the verdict is stable — go
-            # straight to eager on every future call
-            _bounded_put(_runtime_eager, base_key, True)
+            # the verdict is stable for THESE tables (uid-keyed), so go
+            # straight to eager on every future call against them
+            _bounded_put(_runtime_eager, runtime_key, True)
         return result
     return None

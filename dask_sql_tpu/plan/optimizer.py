@@ -330,7 +330,24 @@ def _reorder_chain(root: LogicalJoin, filt_conjuncts: List[RexNode]):
             joined.add(li)
         return bad
 
-    orig_stranded = count_stranded(list(range(len(leaves))))
+    # Stranded steps in the ORIGINAL plan are counted against its actual
+    # (possibly bushy) tree — a join node is a cross step only if no
+    # connector within its subtree spans its two children. Linearizing the
+    # original into a left-deep sequence would falsely count connected bushy
+    # joins as stranded and rewrite plans that need no help (ADVICE r1).
+    leaf_iter = iter(range(len(leaves)))
+
+    def tree_stranded(j: RelNode) -> Tuple[Set[int], int]:
+        if isinstance(j, LogicalJoin) and j.join_type in ("INNER", "CROSS"):
+            lset, lbad = tree_stranded(j.left)
+            rset, rbad = tree_stranded(j.right)
+            here = lset | rset
+            connected = any(ls & lset and ls & rset and ls <= here
+                            for _, ls in connectors)
+            return here, lbad + rbad + (0 if connected else 1)
+        return {next(leaf_iter)}, 0
+
+    orig_stranded = tree_stranded(root)[1]
     if orig_stranded == 0:
         return None
 

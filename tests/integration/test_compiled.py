@@ -90,13 +90,20 @@ def test_compiled_path_used(c):
 @_needs_compiled
 def test_left_join_actually_compiles(c):
     """LEFT joins must run compiled (guards against trace-breaking syncs in
-    the masked-gather path)."""
+    the masked-gather path). The build side needs UNIQUE keys: a duplicate
+    build key (user_table_2 has one) is a legitimate runtime fallback, and
+    this test must observe a clean compile-and-run, not that fallback."""
+    c.create_table("lj_build", pd.DataFrame({"user_id": [1, 2, 4],
+                                             "c": [10, 20, 40]}))
     before_uns = compiled.stats["unsupported"]
     before = compiled.stats["compiles"] + compiled.stats["hits"]
+    fb = compiled.stats["fallbacks"]
     c.sql("SELECT u1.user_id, u2.c FROM user_table_1 u1 "
-          "LEFT JOIN user_table_2 u2 ON u1.user_id = u2.user_id")
+          "LEFT JOIN lj_build u2 ON u1.user_id = u2.user_id")
     assert compiled.stats["compiles"] + compiled.stats["hits"] == before + 1
     assert compiled.stats["unsupported"] == before_uns
+    assert compiled.stats["fallbacks"] == fb
+    c.drop_table("lj_build")
 
 
 @_needs_compiled
@@ -273,3 +280,90 @@ def test_anti_join_comparison_residual_compiles(c, monkeypatch):
     assert sorted(comp.ok.unique().tolist()) == [2, 3]
     assert compiled.stats["compiles"] + compiled.stats["hits"] == before + 1
     c.drop_table("resid_li")
+
+
+@_needs_compiled
+def test_cache_hit_on_reloaded_identical_data(c):
+    """Reloading the same data (new Table objects, equal content) must HIT
+    the program cache: the key is shapes/dtypes + dictionary content, not
+    table identity — the reference recompiles nothing on new partitions
+    either, and a per-load recompile would dwarf query time in any
+    load-query-drop loop."""
+    from dask_sql_tpu import Context
+
+    def make_df():
+        return pd.DataFrame({"k": ["x", "y", "x", "z"] * 5,
+                             "v": list(range(20))})
+
+    c1 = Context()
+    c1.create_table("reload_t", make_df())
+    q = "SELECT k, SUM(v) AS s FROM reload_t GROUP BY k"
+    r1 = c1.sql(q, return_futures=False)
+    compiles = compiled.stats["compiles"]
+    hits = compiled.stats["hits"]
+
+    c2 = Context()  # fresh context, freshly-built identical frame
+    c2.create_table("reload_t", make_df())
+    r2 = c2.sql(q, return_futures=False)
+    assert compiled.stats["compiles"] == compiles, "recompiled on reload"
+    assert compiled.stats["hits"] == hits + 1
+    pd.testing.assert_frame_equal(
+        r1.sort_values("k", ignore_index=True),
+        r2.sort_values("k", ignore_index=True), check_dtype=False)
+
+    # different dictionary content => different program (string constants
+    # are baked in), so this must NOT hit the stale entry
+    c3 = Context()
+    df3 = make_df()
+    df3.loc[3, "k"] = "w"  # same shape, same dtypes, new dictionary
+    c3.create_table("reload_t", df3)
+    r3 = c3.sql(q, return_futures=False)
+    assert compiled.stats["compiles"] == compiles + 1
+    assert set(r3["k"]) == {"w", "x", "y", "z"}
+    assert int(r3.set_index("k").loc["w", "s"]) == 3
+
+
+@_needs_compiled
+def test_wide_build_side_uses_gather_strategy(c, monkeypatch):
+    """Past the build-width cutoff the TPU path must fall back to the
+    probe-gather join (ADVICE r1 finding 3) and still produce exact
+    results."""
+    from dask_sql_tpu.ops import pallas_kernels
+    monkeypatch.setattr(pallas_kernels, "_on_tpu", lambda: True)
+    monkeypatch.setattr(compiled, "_MERGE_BUILD_WIDTH", 2)
+    wide = pd.DataFrame({"user_id": [1, 2, 3],
+                         **{f"w{i}": [i, i + 1, i + 2] for i in range(6)}})
+    c.create_table("wide_build", wide)
+    before = compiled.stats["compiles"] + compiled.stats["hits"]
+    comp, eager = _both_paths(
+        c, "SELECT u1.user_id, w.w0, w.w5 FROM user_table_1 u1 "
+           "JOIN wide_build w ON u1.user_id = w.user_id")
+    _assert_same(comp, eager, ordered=False)
+    assert compiled.stats["compiles"] + compiled.stats["hits"] == before + 1
+    c.drop_table("wide_build")
+
+
+@_needs_compiled
+def test_runtime_verdict_not_inherited_by_reloaded_data(c):
+    """A duplicate-build-key fallback is pinned to the exact tables (uid),
+    NOT the layout fingerprint: reloading corrected data with the same
+    shapes/dtypes must get the compiled path back."""
+    from dask_sql_tpu import Context
+
+    q = ("SELECT p.k, b.v FROM rv_probe p JOIN rv_build b ON p.k = b.k")
+    c1 = Context()
+    c1.create_table("rv_probe", pd.DataFrame({"k": [1, 2, 3, 4]}))
+    c1.create_table("rv_build", pd.DataFrame({"k": [1, 1, 2, 4],
+                                              "v": [9, 8, 7, 6]}))
+    fb = compiled.stats["fallbacks"]
+    c1.sql(q, return_futures=False)
+    assert compiled.stats["fallbacks"] > fb  # non-unique build -> eager
+
+    c2 = Context()  # same layout, corrected (unique) keys
+    c2.create_table("rv_probe", pd.DataFrame({"k": [1, 2, 3, 4]}))
+    c2.create_table("rv_build", pd.DataFrame({"k": [1, 3, 2, 4],
+                                              "v": [9, 8, 7, 6]}))
+    fb2 = compiled.stats["fallbacks"]
+    r = c2.sql(q, return_futures=False)
+    assert compiled.stats["fallbacks"] == fb2, "inherited stale exile"
+    assert sorted(r["k"].tolist()) == [1, 2, 3, 4]
