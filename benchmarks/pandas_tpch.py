@@ -20,6 +20,11 @@ import pandas as pd
 _TS = pd.Timestamp
 
 
+def _sql_sum(s):
+    """SQL SUM over zero rows is NULL, not 0 (pandas' .sum() says 0)."""
+    return s.sum() if len(s) else float("nan")
+
+
 def q1(d):
     li = d["lineitem"]
     x = li[li["l_shipdate"] <= _TS("1998-09-02")].copy()
@@ -106,7 +111,7 @@ def q6(d):
            & (li["l_discount"] >= 0.05) & (li["l_discount"] <= 0.07)
            & (li["l_quantity"] < 24)]
     return pd.DataFrame(
-        {"revenue": [(x["l_extendedprice"] * x["l_discount"]).sum()]})
+        {"revenue": [_sql_sum(x["l_extendedprice"] * x["l_discount"])]})
 
 
 def q7(d):
@@ -299,7 +304,7 @@ def q17(d):
     # subset (same table, so the merge result is exactly lineitem-of-part)
     thresh = 0.2 * m.groupby("l_partkey")["l_quantity"].transform("mean")
     x = m[m["l_quantity"] < thresh]
-    return pd.DataFrame({"avg_yearly": [x["l_extendedprice"].sum() / 7.0]})
+    return pd.DataFrame({"avg_yearly": [_sql_sum(x["l_extendedprice"]) / 7.0]})
 
 
 def q18(d):
@@ -334,7 +339,7 @@ def q19(d):
           & m["l_quantity"].between(20, 30) & m["p_size"].between(1, 15))
     x = m[c1 | c2 | c3]
     return pd.DataFrame(
-        {"revenue": [(x["l_extendedprice"] * (1 - x["l_discount"])).sum()]})
+        {"revenue": [_sql_sum(x["l_extendedprice"] * (1 - x["l_discount"]))]})
 
 
 def q20(d):

@@ -54,6 +54,7 @@ class Context:
         self.schema = {self.DEFAULT_SCHEMA_NAME: SchemaContainer(self.DEFAULT_SCHEMA_NAME)}
         self.server = None
         self.mesh = mesh
+        self._has_chunked = False
         # register default input plugins (reference context.py:113-119 order)
         for plugin in (DeviceTableInputPlugin(), PandasLikeInputPlugin(),
                        DictInputPlugin(), ArrowInputPlugin(), HiveInputPlugin(),
@@ -78,13 +79,48 @@ class Context:
                      format: Optional[str] = None, persist: bool = False,
                      schema_name: Optional[str] = None,
                      statistics: Optional[dict] = None, gpu: bool = False,
+                     chunked: bool = False, batch_rows: Optional[int] = None,
                      **kwargs):
         """Register anything the input plugins understand as a SQL table.
 
         ``persist`` keeps parity with the reference (context.py:121-204); data
         always lives on device here, so it is a no-op flag.
+
+        ``chunked=True``: out-of-HBM mode — the data stays host-resident as
+        encoded columnar batches (``batch_rows`` rows each) and queries
+        stream it through the device one batch at a time
+        (physical/streaming.py), the TPU analogue of the reference's
+        partitioned-dataframe ingestion (input_utils/convert.py:38-62).
+        Accepts a pandas frame or a parquet path.
         """
         schema_name = schema_name or self.schema_name
+        if chunked:
+            if self.mesh is not None:
+                raise NotImplementedError(
+                    "chunked tables on a mesh: stream batches per host "
+                    "instead (not yet wired)")
+            from .io.chunked import DEFAULT_BATCH_ROWS, ChunkedSource
+            rows = batch_rows or DEFAULT_BATCH_ROWS
+            if isinstance(input_table, str):
+                source = ChunkedSource.from_parquet(input_table,
+                                                    batch_rows=rows)
+            else:
+                import pandas as pd
+                if not isinstance(input_table, pd.DataFrame):
+                    raise TypeError("chunked=True accepts a pandas frame "
+                                    "or a parquet path")
+                source = ChunkedSource.from_pandas(input_table,
+                                                   batch_rows=rows)
+            self._has_chunked = True
+            entry = TableEntry(
+                table=source.schema_table(), chunked=source,
+                statistics=statistics or {"row_count": source.n_rows},
+                filepath=input_table if isinstance(input_table, str) else None)
+            self.schema[schema_name].tables[table_name.lower()] = entry
+            logger.debug("Registered chunked table %s.%s (%d rows, %d batches)",
+                         schema_name, table_name, source.n_rows,
+                         source.n_batches)
+            return
         table = InputUtil.to_table(input_table, file_format=format,
                                    table_name=table_name, **kwargs)
         row_valid = None
@@ -189,6 +225,15 @@ class Context:
 
         if isinstance(stmt, A.QueryStatement):
             plan = self._get_plan(stmt.query, sql)
+            # out-of-HBM tables route through the streaming executor — the
+            # resident paths below must never compute on their binding stubs.
+            # (_has_chunked guards the per-query plan walk + import: contexts
+            # that never registered a chunked table skip it entirely)
+            if self._has_chunked:
+                from .physical.streaming import (execute_streaming,
+                                                 plan_references_chunked)
+                if plan_references_chunked(plan, self):
+                    return execute_streaming(plan, self)
             # whole-plan jit (one device dispatch per query); falls back to
             # the eager per-op executor for plan shapes outside its subset
             from .physical.compiled import try_execute_compiled

@@ -35,6 +35,10 @@ class TableEntry:
     # mesh mode: columns are padded to device-count divisibility and
     # row-sharded; row_valid (same sharding) marks the real rows
     row_valid: Any = None
+    # out-of-HBM mode: host-resident ChunkedSource (io/chunked.py);
+    # ``table`` is then a 1-row binding stub, and execution must go through
+    # physical/streaming.py (context routes it)
+    chunked: Any = None
 
 
 class SchemaContainer:

@@ -1,0 +1,452 @@
+"""Streaming (out-of-HBM) execution over chunked tables.
+
+The reference runs every query out-of-core by construction (partitioned dask
+dataframes, input_utils/convert.py:38-62).  Here the compiled whole-plan-jit
+executor wants resident device tables, so tables bigger than HBM register as
+``ChunkedSource`` (io/chunked.py) and this module executes plans over them
+in the classic two-phase shape:
+
+  1. the plan is SPLIT at the lowest aggregate (or top-k sort) above the
+     chunked scan: everything below runs PER BATCH through the ordinary
+     compiled pipeline (same shapes + shared dictionaries => one compile,
+     N-1 program-cache hits), everything above runs once on the merged
+     partials;
+  2. partial aggregates merge by algebra: SUM/$SUM0 -> SUM, COUNT -> SUM,
+     MIN/MAX -> MIN/MAX, AVG -> (SUM, COUNT) partials + a final division;
+     top-k merges as top-k of concatenated per-batch top-k;
+  3. joins on the streamed path keep the build (resident) side fixed:
+     subtrees not containing the chunked scan are materialized ONCE into
+     temp tables and reused across batches (build-side resident,
+     probe-side streamed).
+
+Plans outside this shape (two chunked scans, chunked on the NULL-extended
+side of an outer join, distinct/custom aggregates, global sorts without
+LIMIT) raise ``StreamingUnsupported`` with a reason — never a silent wrong
+answer on schema stubs.
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..datacontainer import TableEntry
+from ..plan.nodes import (
+    AggCall, Field, LogicalAggregate, LogicalFilter, LogicalJoin,
+    LogicalProject, LogicalSort, LogicalTableScan, RelNode, RexCall,
+    RexInputRef,
+)
+from ..table import Table
+from ..types import BIGINT, DOUBLE
+
+logger = logging.getLogger(__name__)
+
+STREAM_SCHEMA = "__stream__"
+BATCH_TABLE = "batch"
+
+_MERGEABLE = {"SUM", "$SUM0", "COUNT", "MIN", "MAX", "AVG"}
+
+
+class StreamingUnsupported(RuntimeError):
+    """Plan shape the streaming executor cannot run out-of-core."""
+
+
+# ---------------------------------------------------------------------------
+# plan inspection
+# ---------------------------------------------------------------------------
+
+def _chunked_scans(plan: RelNode, context) -> List[LogicalTableScan]:
+    out = []
+
+    def walk(rel: RelNode):
+        if isinstance(rel, LogicalTableScan):
+            entry = context.schema[rel.schema_name].tables.get(rel.table_name)
+            if entry is not None and getattr(entry, "chunked", None) is not None:
+                out.append(rel)
+            return
+        for i in rel.inputs:
+            walk(i)
+        # scalar-subquery plans hide extra scans inside rex trees
+        from ..plan.nodes import RexScalarSubquery
+
+        def walk_rex(rex):
+            if isinstance(rex, RexScalarSubquery):
+                walk(rex.plan)
+            for o in getattr(rex, "operands", []) or []:
+                walk_rex(o)
+
+        if isinstance(rel, LogicalProject):
+            for e in rel.exprs:
+                walk_rex(e)
+        elif isinstance(rel, LogicalFilter):
+            walk_rex(rel.condition)
+        elif isinstance(rel, LogicalJoin) and rel.condition is not None:
+            walk_rex(rel.condition)
+
+    walk(plan)
+    return out
+
+
+def plan_references_chunked(plan: RelNode, context) -> bool:
+    return bool(_chunked_scans(plan, context))
+
+
+def _path_to(plan: RelNode, target: RelNode) -> Optional[List[RelNode]]:
+    """Nodes from root to target (inclusive), by identity."""
+    if plan is target:
+        return [plan]
+    for i in plan.inputs:
+        sub = _path_to(i, target)
+        if sub is not None:
+            return [plan] + sub
+    return None
+
+
+def _replace(plan: RelNode, old: RelNode, new: RelNode) -> RelNode:
+    if plan is old:
+        return new
+    if not plan.inputs:
+        return plan
+    return plan.with_inputs([_replace(i, old, new) for i in plan.inputs])
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+def _run_resident(plan: RelNode, context) -> Table:
+    from .compiled import try_execute_compiled
+    from .rel.executor import RelExecutor
+
+    result = try_execute_compiled(plan, context)
+    if result is None:
+        result = RelExecutor(context).execute(plan)
+    return result
+
+
+_tmp_counter = [0]
+
+
+def _register_temp(context, table: Table, row_valid=None) -> LogicalTableScan:
+    """Register a materialized table under __stream__ and return its scan."""
+    if STREAM_SCHEMA not in context.schema:
+        context.create_schema(STREAM_SCHEMA)
+    _tmp_counter[0] += 1
+    name = f"t{_tmp_counter[0]}"
+    # intermediate schemas may carry duplicate/empty names; ordinals are what
+    # matter downstream, so names are sanitized for catalog registration
+    names = [f"c{i}" for i in range(table.num_columns)]
+    table = table.with_names(names)
+    context.schema[STREAM_SCHEMA].tables[name] = TableEntry(
+        table=table, row_valid=row_valid)
+    fields = [Field(n, c.stype) for n, c in zip(names, table.columns)]
+    return LogicalTableScan(schema_name=STREAM_SCHEMA, table_name=name,
+                            schema=fields)
+
+
+def _set_batch_entry(context, table: Table, row_valid) -> None:
+    if STREAM_SCHEMA not in context.schema:
+        context.create_schema(STREAM_SCHEMA)
+    context.schema[STREAM_SCHEMA].tables[BATCH_TABLE] = TableEntry(
+        table=table, row_valid=row_valid)
+
+
+def _cleanup(context) -> None:
+    context.schema.pop(STREAM_SCHEMA, None)
+
+
+def _check_join_streamable(join: LogicalJoin, chunked_on_left: bool) -> None:
+    jt = join.join_type
+    ok = (jt == "INNER"
+          or (jt in ("LEFT", "SEMI", "ANTI") and chunked_on_left)
+          or (jt == "RIGHT" and not chunked_on_left))
+    if not ok:
+        raise StreamingUnsupported(
+            f"{jt} join with the chunked table on the NULL-extended side "
+            "cannot stream (every build row must see all probe rows)")
+
+
+def _stream_partial_plans(split: RelNode, scan: LogicalTableScan,
+                          path: List[RelNode], context) -> RelNode:
+    """The per-batch subtree: split.input with (a) the chunked scan replaced
+    by the batch scan and (b) off-path join subtrees pre-materialized."""
+    path_ids = {id(p) for p in path}
+
+    def rebuild(rel: RelNode) -> RelNode:
+        if rel is scan:
+            entry = context.schema[scan.schema_name].tables[scan.table_name]
+            fields = list(scan.schema)
+            return LogicalTableScan(schema_name=STREAM_SCHEMA,
+                                    table_name=BATCH_TABLE, schema=fields)
+        if id(rel) not in path_ids:
+            # off the streamed path: resident — materialize once
+            if isinstance(rel, LogicalTableScan):
+                e = context.schema[rel.schema_name].tables[rel.table_name]
+                if getattr(e, "chunked", None) is not None:
+                    raise StreamingUnsupported(
+                        "more than one chunked table in the plan")
+                return rel
+            t = _run_resident(rel, context)
+            tmp = _register_temp(context, t)
+            # keep this subtree's field stypes (names are sanitized)
+            tmp = LogicalTableScan(
+                schema_name=tmp.schema_name, table_name=tmp.table_name,
+                schema=[Field(f2.name, f1.stype)
+                        for f1, f2 in zip(rel.schema, tmp.schema)])
+            return tmp
+        if isinstance(rel, LogicalJoin):
+            left_on = any(id(rel.left) == id(p) for p in path) or rel.left is scan
+            _check_join_streamable(rel, chunked_on_left=left_on)
+        return rel.with_inputs([rebuild(i) for i in rel.inputs])
+
+    return rebuild(split.inputs[0] if not isinstance(split, LogicalTableScan)
+                   else split)
+
+
+def _partial_and_merge_aggs(agg: LogicalAggregate):
+    """(partial_aggs, partial_fields, merge_aggs, post_exprs, needs_project)
+
+    Partial layout: one column per non-AVG call, (sum, count) for AVG.
+    Merge layout mirrors the partial layout; post_exprs map the merged
+    columns back to agg.schema (the AVG division happens here).
+    """
+    gk = len(agg.group_keys)
+    partial_aggs: List[AggCall] = []
+    partial_fields: List[Field] = []
+    merge_aggs: List[AggCall] = []
+    post_exprs: List = []
+    needs_project = False
+    agg_fields = agg.schema[gk:]
+    for call, field in zip(agg.aggs, agg_fields):
+        if call.udaf is not None or call.distinct:
+            raise StreamingUnsupported(
+                f"{'DISTINCT ' if call.distinct else ''}{call.op} does not "
+                "merge across batches")
+        if call.op not in _MERGEABLE:
+            raise StreamingUnsupported(f"aggregate {call.op} does not merge")
+        base = gk + len(partial_aggs)
+        if call.op == "AVG":
+            needs_project = True
+            s_st = field.stype if field.stype.name in ("DOUBLE", "FLOAT",
+                                                       "DECIMAL") else DOUBLE
+            partial_aggs.append(AggCall("SUM", list(call.args), False, s_st,
+                                        f"{field.name}$sum",
+                                        filter_arg=call.filter_arg))
+            partial_aggs.append(AggCall("COUNT", list(call.args), False,
+                                        BIGINT, f"{field.name}$cnt",
+                                        filter_arg=call.filter_arg))
+            partial_fields.append(Field(f"{field.name}$sum", s_st))
+            partial_fields.append(Field(f"{field.name}$cnt", BIGINT))
+            merge_aggs.append(AggCall("SUM", [base], False, s_st,
+                                      f"{field.name}$sum"))
+            merge_aggs.append(AggCall("$SUM0", [base + 1], False, BIGINT,
+                                      f"{field.name}$cnt"))
+            post_exprs.append(("avg", base, base + 1, field))
+        else:
+            merge_op = {"SUM": "SUM", "$SUM0": "$SUM0", "COUNT": "$SUM0",
+                        "MIN": "MIN", "MAX": "MAX"}[call.op]
+            partial_aggs.append(AggCall(call.op, list(call.args), False,
+                                        field.stype, field.name,
+                                        filter_arg=call.filter_arg))
+            partial_fields.append(Field(field.name, field.stype))
+            merge_aggs.append(AggCall(merge_op, [base], False, field.stype,
+                                      field.name))
+            post_exprs.append(("ref", base, None, field))
+    return partial_aggs, partial_fields, merge_aggs, post_exprs, needs_project
+
+
+def _host_partial(result: Table) -> tuple:
+    """Fetch a partial result to host NOW: streaming's memory bound is one
+    batch resident at a time, so partial outputs must not pin device
+    buffers across iterations. Returns (names, per-col host tuples)."""
+    import jax
+
+    bufs = []
+    for c in result.columns:
+        bufs.append(c.data)
+        if c.mask is not None:
+            bufs.append(c.mask)
+    host = iter(jax.device_get(bufs) if bufs else [])
+    cols = []
+    for c in result.columns:
+        data = next(host)
+        mask = next(host) if c.mask is not None else None
+        cols.append((np.asarray(data), None if mask is None
+                     else np.asarray(mask), c.stype, c.dictionary))
+    return (list(result.names), cols)
+
+
+def _concat_partials_to_temp(partials: List[tuple], context
+                             ) -> LogicalTableScan:
+    """Concatenate host partial results into one temp device table,
+    preserving stypes and dictionaries (all batches ran the same program
+    over the same shared dictionaries, so per-column dictionaries agree —
+    verified, with a re-encode fallback if an eager batch diverged)."""
+    import jax.numpy as jnp
+
+    from ..table import Column
+
+    names, first_cols = partials[0]
+    ncols = len(first_cols)
+    cols = []
+    for ci in range(ncols):
+        per = [p[1][ci] for p in partials]
+        stype, d0 = per[0][2], per[0][3]
+        same_dict = all(
+            d is d0 or (d is not None and d0 is not None
+                        and len(d) == len(d0) and (d == d0).all())
+            for _, _, _, d in per)
+        if not same_dict:
+            # decode + re-encode under a fresh unified dictionary
+            decoded = np.concatenate([
+                d[np.clip(data, 0, len(d) - 1)].astype(object)
+                for data, _, _, d in per])
+            col = Column.from_numpy(decoded)
+            mask_parts = [m if m is not None else np.ones(len(data), bool)
+                          for data, m, _, _ in per]
+            if any(p[1] is not None for p in per):
+                col = col.with_mask(jnp.asarray(np.concatenate(mask_parts))
+                                    & col.valid_mask())
+            cols.append(col)
+            continue
+        data = np.concatenate([data for data, _, _, _ in per])
+        if any(m is not None for _, m, _, _ in per):
+            mask = np.concatenate(
+                [m if m is not None else np.ones(len(dd), bool)
+                 for dd, m, _, _ in per])
+            mask = jnp.asarray(mask)
+        else:
+            mask = None
+        cols.append(Column(jnp.asarray(data), stype, mask, d0))
+    t = Table([f"c{i}" for i in range(ncols)], cols)
+    return _register_temp(context, t)
+
+
+def execute_streaming(plan: RelNode, context) -> Table:
+    """Run a plan that references exactly one chunked table."""
+    scans = _chunked_scans(plan, context)
+    if len(scans) != 1:
+        raise StreamingUnsupported(
+            f"{len(scans)} chunked scans in one plan (exactly 1 supported; "
+            "correlated subqueries over the chunked table re-scan it)")
+    scan = scans[0]
+    entry = context.schema[scan.schema_name].tables[scan.table_name]
+    source = entry.chunked
+
+    path = _path_to(plan, scan)
+    if path is None:
+        # the scan lives inside a scalar-subquery rex plan, which rel-input
+        # traversal cannot reach (it would re-scan the table per outer row)
+        raise StreamingUnsupported(
+            "chunked table referenced inside a scalar subquery cannot "
+            "stream; materialize the subquery first")
+    # lowest aggregate above the scan; or a LIMIT-ed sort (top-k)
+    split: Optional[RelNode] = None
+    for node in reversed(path[:-1]):
+        if isinstance(node, LogicalAggregate):
+            split = node
+            break
+        if isinstance(node, LogicalSort) and node.limit is not None:
+            split = node
+            break
+    if split is None:
+        raise StreamingUnsupported(
+            "no aggregate or LIMIT above the chunked scan — the full result "
+            "would be as large as the table; add a GROUP BY or LIMIT")
+
+    try:
+        if isinstance(split, LogicalAggregate):
+            result = _stream_aggregate(plan, split, scan, path, source,
+                                       context)
+        else:
+            result = _stream_topk(plan, split, scan, path, source, context)
+    finally:
+        _cleanup(context)
+    # temp-table scans carry sanitized column names (c0, c1, ...); the
+    # user-visible names are the plan root's schema, always
+    return result.with_names([f.name for f in plan.schema])
+
+
+def _run_batches(partial_plan: RelNode, source, context) -> List[tuple]:
+    from .compiled import try_execute_compiled
+    from .rel.executor import RelExecutor
+
+    out = []
+    for bi in range(source.n_batches):
+        table, row_valid = source.batch_table(bi)
+        _set_batch_entry(context, table, row_valid)
+        result = try_execute_compiled(partial_plan, context)
+        if result is None:
+            result = RelExecutor(context).execute(partial_plan)
+        # fetch the (small, post-aggregate) partial to host NOW: at most one
+        # batch stays resident on device — the whole point of streaming
+        out.append(_host_partial(result))
+        logger.debug("streamed batch %d/%d -> %d partial rows", bi + 1,
+                     source.n_batches, result.num_rows)
+    return out
+
+
+def _stream_aggregate(plan, agg: LogicalAggregate, scan, path, source,
+                      context) -> Table:
+    gk = len(agg.group_keys)
+    (partial_aggs, partial_fields, merge_aggs, post_exprs,
+     needs_project) = _partial_and_merge_aggs(agg)
+
+    below = _stream_partial_plans(agg, scan, path, context)
+    group_fields = agg.schema[:gk]
+    partial_schema = list(group_fields) + partial_fields
+    partial_plan = LogicalAggregate(input=below,
+                                    group_keys=list(agg.group_keys),
+                                    aggs=partial_aggs, schema=partial_schema)
+
+    partials = _run_batches(partial_plan, source, context)
+
+    ptmp = _concat_partials_to_temp(partials, context)
+    ptmp = LogicalTableScan(
+        schema_name=ptmp.schema_name, table_name=ptmp.table_name,
+        schema=[Field(f2.name, f1.stype)
+                for f1, f2 in zip(partial_schema, ptmp.schema)])
+
+    merge_schema = list(group_fields) + [
+        Field(a.name, a.stype) for a in merge_aggs]
+    merge = LogicalAggregate(input=ptmp,
+                             group_keys=list(range(gk)),
+                             aggs=merge_aggs, schema=merge_schema)
+    final: RelNode = merge
+    if needs_project:
+        exprs = [RexInputRef(i, f.stype) for i, f in enumerate(group_fields)]
+        for kind, i, j, field in post_exprs:
+            if kind == "ref":
+                exprs.append(RexInputRef(i, field.stype))
+            else:
+                num = RexInputRef(i, merge_schema[i].stype)
+                den = RexCall("CAST", [RexInputRef(j, BIGINT)], DOUBLE,
+                              info=DOUBLE)
+                exprs.append(RexCall("/", [num, den], field.stype))
+        final = LogicalProject(input=merge, exprs=exprs,
+                               schema=list(agg.schema))
+
+    rewritten = _replace(plan, agg, final)
+    return _run_resident(rewritten, context)
+
+
+def _stream_topk(plan, sort: LogicalSort, scan, path, source,
+                 context) -> Table:
+    keep = (sort.limit or 0) + (sort.offset or 0)
+    below = _stream_partial_plans(sort, scan, path, context)
+    partial_plan = LogicalSort(input=below, collation=sort.collation,
+                               offset=0, limit=keep,
+                               schema=list(sort.schema))
+    partials = _run_batches(partial_plan, source, context)
+
+    ptmp = _concat_partials_to_temp(partials, context)
+    ptmp = LogicalTableScan(
+        schema_name=ptmp.schema_name, table_name=ptmp.table_name,
+        schema=[Field(f2.name, f1.stype)
+                for f1, f2 in zip(sort.schema, ptmp.schema)])
+    final = LogicalSort(input=ptmp, collation=sort.collation,
+                        offset=sort.offset, limit=sort.limit,
+                        schema=list(sort.schema))
+    rewritten = _replace(plan, sort, final)
+    return _run_resident(rewritten, context)

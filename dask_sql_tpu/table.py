@@ -97,45 +97,13 @@ class Column:
     @staticmethod
     def from_numpy(values: np.ndarray, stype: Optional[SqlType] = None,
                    mask: Optional[np.ndarray] = None) -> "Column":
-        values = np.asarray(values)
-        if stype is None:
-            stype = sql_type_from_numpy(values.dtype)
-        if values.dtype.kind in ("O", "U", "S") or stype.is_string:
-            return Column._encode_strings(values, mask)
-        if values.dtype.kind == "M":
-            vals = values.astype("datetime64[us]").astype(np.int64)
-            na = np.isnat(values)
-            if na.any():
-                mask = ~na if mask is None else (mask & ~na)
-            return Column(jnp.asarray(vals), stype, _as_mask(mask))
-        if values.dtype.kind == "m":
-            vals = values.astype("timedelta64[ms]").astype(np.int64)
-            na = np.isnat(values)
-            if na.any():
-                mask = ~na if mask is None else (mask & ~na)
-            return Column(jnp.asarray(vals), stype, _as_mask(mask))
-        if values.dtype.kind == "f":
-            # NaN means NULL on ingestion (pandas semantics: the reference's
-            # dask frames treat NaN as missing, mappings.py:67-83)
-            na = np.isnan(values)
-            if na.any():
-                mask = ~na if mask is None else (np.asarray(mask, bool) & ~na)
-                values = np.where(na, 0.0, values)
-        dtype = physical_dtype(stype)
-        return Column(jnp.asarray(values.astype(dtype, copy=False)), stype, _as_mask(mask))
+        data, m, st, dictionary = host_encode_numpy(values, stype, mask)
+        return Column(jnp.asarray(data), st, _as_mask(m), dictionary)
 
     @staticmethod
     def _encode_strings(values: np.ndarray, mask: Optional[np.ndarray]) -> "Column":
-        values = np.asarray(values, dtype=object)
-        isna = np.array([v is None or (isinstance(v, float) and np.isnan(v)) for v in values])
-        safe = np.where(isna, "", values).astype(str)
-        dictionary, codes = np.unique(safe, return_inverse=True)
-        codes = codes.astype(np.int32)
-        if isna.any():
-            m = ~isna if mask is None else (np.asarray(mask, bool) & ~isna)
-        else:
-            m = mask
-        return Column(jnp.asarray(codes), VARCHAR, _as_mask(m), dictionary.astype(object))
+        data, m, st, dictionary = _host_encode_strings(values, mask)
+        return Column(jnp.asarray(data), st, _as_mask(m), dictionary)
 
     @staticmethod
     def from_scalar(scalar: Scalar, length: int) -> "Column":
@@ -437,7 +405,77 @@ _PANDAS_NULLABLE_NUMPY = {
 }
 
 
-def _series_to_column(s) -> Column:
+def host_encode_numpy(values: np.ndarray, stype: Optional[SqlType] = None,
+                      mask: Optional[np.ndarray] = None,
+                      dictionary: Optional[np.ndarray] = None):
+    """Ingestion encoding on HOST arrays: (data, mask, stype, dictionary).
+
+    The single source of truth for ingestion semantics — `Column.from_numpy`
+    is this plus a device upload, and the chunked/out-of-core reader
+    (io/chunked.py) uses it directly so batches stay host-side until their
+    turn to stream through the device. ``dictionary``: optional pre-built
+    SORTED global dictionary for string columns (shared across batches so
+    every batch compiles to the same program)."""
+    values = np.asarray(values)
+    if stype is None:
+        stype = sql_type_from_numpy(values.dtype)
+    if values.dtype.kind in ("O", "U", "S") or stype.is_string:
+        return _host_encode_strings(values, mask, dictionary)
+    if values.dtype.kind == "M":
+        vals = values.astype("datetime64[us]").astype(np.int64)
+        na = np.isnat(values)
+        if na.any():
+            mask = ~na if mask is None else (mask & ~na)
+        return vals, mask, stype, None
+    if values.dtype.kind == "m":
+        vals = values.astype("timedelta64[ms]").astype(np.int64)
+        na = np.isnat(values)
+        if na.any():
+            mask = ~na if mask is None else (mask & ~na)
+        return vals, mask, stype, None
+    if values.dtype.kind == "f":
+        # NaN means NULL on ingestion (pandas semantics: the reference's
+        # dask frames treat NaN as missing, mappings.py:67-83)
+        na = np.isnan(values)
+        if na.any():
+            mask = ~na if mask is None else (np.asarray(mask, bool) & ~na)
+            values = np.where(na, 0.0, values)
+    dtype = physical_dtype(stype)
+    return values.astype(dtype, copy=False), mask, stype, None
+
+
+def string_uniques(values: np.ndarray) -> np.ndarray:
+    """Sorted unique strings of an object array (NULLs -> \"\"), the shared
+    null-semantics for ingestion and the chunked reader's dictionary pass."""
+    values = np.asarray(values, dtype=object)
+    isna = np.array([v is None or (isinstance(v, float) and np.isnan(v))
+                     for v in values])
+    safe = np.where(isna, "", values).astype(str)
+    return np.unique(safe).astype(object)
+
+
+def _host_encode_strings(values: np.ndarray, mask: Optional[np.ndarray],
+                         dictionary: Optional[np.ndarray] = None):
+    values = np.asarray(values, dtype=object)
+    isna = np.array([v is None or (isinstance(v, float) and np.isnan(v)) for v in values])
+    safe = np.where(isna, "", values).astype(str)
+    if dictionary is None:
+        dictionary, codes = np.unique(safe, return_inverse=True)
+        dictionary = dictionary.astype(object)
+    else:
+        # shared global dictionary (sorted): encode via binary search; every
+        # value is guaranteed present by the two-pass chunked reader
+        codes = np.searchsorted(dictionary.astype(str), safe)
+    codes = codes.astype(np.int32)
+    if isna.any():
+        m = ~isna if mask is None else (np.asarray(mask, bool) & ~isna)
+    else:
+        m = mask
+    return codes, m, VARCHAR, dictionary
+
+
+def host_encode_series(s, dictionary: Optional[np.ndarray] = None):
+    """Host-side encoding of a pandas Series: (data, mask, stype, dict)."""
     import pandas as pd
 
     dtype = s.dtype
@@ -446,28 +484,37 @@ def _series_to_column(s) -> Column:
         arr = s.array
         mask = ~np.asarray(arr.isna())
         vals = arr.to_numpy(dtype=_PANDAS_NULLABLE_NUMPY[str(dtype)], na_value=0)
-        return Column.from_numpy(vals, mask=mask if not mask.all() else None)
+        return host_encode_numpy(vals, mask=mask if not mask.all() else None,
+                                 dictionary=dictionary)
     if str(dtype) in ("string", "str") or (
         hasattr(pd, "StringDtype") and isinstance(dtype, pd.StringDtype)
     ):
         vals = s.to_numpy(dtype=object, na_value=None)
-        return Column.from_numpy(vals)
+        return host_encode_numpy(vals, dictionary=dictionary)
     if isinstance(dtype, pd.CategoricalDtype):
+        if dictionary is not None:
+            # a shared global dictionary overrides the per-batch categories:
+            # chunked sources must not mix batch-local codes with a global
+            # dictionary (arrow row groups may carry differing categories)
+            vals = s.astype(object).to_numpy()
+            return host_encode_numpy(vals, dictionary=dictionary)
         cats = s.cat.categories.to_numpy(dtype=object)
         codes = s.cat.codes.to_numpy().astype(np.int32)
         mask = codes >= 0
         if mask.all():
             mask = None
-        return Column(jnp.asarray(np.where(codes < 0, 0, codes)), VARCHAR,
-                      _as_mask(mask), cats)
+        return np.where(codes < 0, 0, codes).astype(np.int32), mask, VARCHAR, cats
     if dtype.kind == "M":
         # tz-aware -> convert to UTC naive
         if getattr(dtype, "tz", None) is not None:
             s = s.dt.tz_convert("UTC").dt.tz_localize(None)
-        return Column.from_numpy(s.to_numpy())
-    if dtype == object:
-        return Column.from_numpy(s.to_numpy())
-    return Column.from_numpy(s.to_numpy())
+        return host_encode_numpy(s.to_numpy(), dictionary=dictionary)
+    return host_encode_numpy(s.to_numpy(), dictionary=dictionary)
+
+
+def _series_to_column(s) -> Column:
+    data, mask, stype, dictionary = host_encode_series(s)
+    return Column(jnp.asarray(data), stype, _as_mask(mask), dictionary)
 
 
 def _has_none(v) -> bool:

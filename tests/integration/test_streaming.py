@@ -1,0 +1,147 @@
+"""Out-of-HBM streaming execution (physical/streaming.py + io/chunked.py).
+
+The reference's execution is out-of-core by construction (partitioned dask
+dataframes, /root/reference/dask_sql/input_utils/convert.py:38-62); here the
+equivalence under test is: a table registered ``chunked=True`` must produce
+the same answers as the resident path while holding at most one batch on
+device, with one compile for all batches (shared dictionaries + fixed batch
+shapes).
+"""
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from benchmarks.tpch import QUERIES, generate_tpch
+from dask_sql_tpu import Context
+from dask_sql_tpu.physical import compiled
+from dask_sql_tpu.physical.streaming import StreamingUnsupported
+
+
+@pytest.fixture(scope="module")
+def tpch_pair():
+    data = generate_tpch(0.01, seed=5)
+    plain = Context()
+    ck = Context()
+    for name, frame in data.items():
+        plain.create_table(name, frame)
+        if name == "lineitem":
+            ck.create_table(name, frame, chunked=True, batch_rows=16384)
+        else:
+            ck.create_table(name, frame)
+    return plain, ck, data
+
+
+def _assert_frames(a, b):
+    a = a.reset_index(drop=True)
+    b = b.reset_index(drop=True)
+    for col in a.columns:
+        if pd.api.types.is_float_dtype(a[col]):
+            a[col] = a[col].astype(np.float64).round(6)
+            b[col] = b[col].astype(np.float64).round(6)
+    cols = list(a.columns)
+    pd.testing.assert_frame_equal(a.sort_values(cols, ignore_index=True),
+                                  b.sort_values(cols, ignore_index=True),
+                                  check_dtype=False, rtol=1e-5, atol=1e-6)
+
+
+# Q1 (heavy groupby+AVG), Q3 (join above the stream, agg+sort+limit),
+# Q6 (global aggregate), Q12 (join + CASE aggregates), Q14 (join + expr agg)
+@pytest.mark.parametrize("qid", [1, 3, 6, 12, 14])
+def test_tpch_chunked_matches_resident(tpch_pair, qid):
+    plain, ck, _ = tpch_pair
+    want = plain.sql(QUERIES[qid], return_futures=False)
+    got = ck.sql(QUERIES[qid], return_futures=False)
+    _assert_frames(want, got)
+
+
+@pytest.mark.skipif(os.environ.get("DSQL_COMPILE") == "0",
+                    reason="asserts compiled-path batch reuse")
+def test_batches_share_one_compiled_program(tpch_pair):
+    _, ck, data = tpch_pair
+    n_batches = (len(data["lineitem"]) + 16383) // 16384
+    assert n_batches >= 3  # the test must actually exercise multi-batch
+    before = dict(compiled.stats)
+    ck.sql(QUERIES[6], return_futures=False)
+    d = {k: compiled.stats[k] - before[k] for k in before}
+    # one compile for the first batch (plus possibly the tiny merge plan);
+    # every further batch must HIT the program cache
+    assert d["hits"] >= n_batches - 1, d
+    assert d["compiles"] <= 2, d
+
+
+def test_chunked_parquet_roundtrip(tmp_path):
+    df = pd.DataFrame({
+        "g": ["x", "y", "z", "x"] * 700,
+        "v": np.arange(2800, dtype=np.float64),
+        "k": np.arange(2800) % 13,
+    })
+    path = str(tmp_path / "t.parquet")
+    df.to_parquet(path, index=False, row_group_size=512)
+    c = Context()
+    c.create_table("t", path, chunked=True, batch_rows=1000)
+    entry = c.schema["root"].tables["t"]
+    assert entry.chunked.n_batches == 3  # 2800 rows / 1000, re-batched
+    got = c.sql("SELECT g, SUM(v) AS s, COUNT(*) AS n FROM t GROUP BY g "
+                "ORDER BY g", return_futures=False)
+    exp = (df.groupby("g").agg(s=("v", "sum"), n=("v", "count"))
+             .reset_index())
+    np.testing.assert_allclose(got["s"], exp["s"])
+    np.testing.assert_array_equal(got["n"], exp["n"])
+
+
+def test_streaming_rejects_unmergeable_shapes(tpch_pair):
+    _, ck, _ = tpch_pair
+    with pytest.raises(StreamingUnsupported, match="DISTINCT"):
+        ck.sql("SELECT COUNT(DISTINCT l_suppkey) AS n FROM lineitem")
+    with pytest.raises(StreamingUnsupported, match="no aggregate or LIMIT"):
+        ck.sql("SELECT l_orderkey FROM lineitem WHERE l_quantity > 1")
+
+
+def test_streaming_null_group_keys():
+    df = pd.DataFrame({"g": ["a", None, "a", None, "b"] * 200,
+                       "v": np.arange(1000, dtype=np.float64)})
+    plain = Context()
+    plain.create_table("t", df)
+    ck = Context()
+    ck.create_table("t", df, chunked=True, batch_rows=128)
+    q = "SELECT g, SUM(v) AS s, COUNT(*) AS n FROM t GROUP BY g"
+    _assert_frames(plain.sql(q, return_futures=False),
+                   ck.sql(q, return_futures=False))
+
+
+def test_chunked_parquet_categorical_dictionaries(tmp_path):
+    """Dictionary-encoded parquet columns whose row-group dictionaries
+    differ must be re-encoded against ONE global dictionary — per-batch
+    categorical codes mixed with a shared dictionary would silently decode
+    to wrong strings (r2 review finding)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    # two row groups with DIFFERENT dictionary orders for the same column
+    t1 = pa.table({"g": pa.array(["b", "a", "b"] * 100).dictionary_encode(),
+                   "v": pa.array(np.arange(300, dtype=np.float64))})
+    t2 = pa.table({"g": pa.array(["c", "b"] * 150).dictionary_encode(),
+                   "v": pa.array(np.arange(300, 600, dtype=np.float64))})
+    path = str(tmp_path / "cat.parquet")
+    with pq.ParquetWriter(path, t1.schema) as w:
+        w.write_table(t1)
+        w.write_table(t2)
+    c = Context()
+    c.create_table("t", path, chunked=True, batch_rows=150)
+    got = c.sql("SELECT g, COUNT(*) AS n, SUM(v) AS s FROM t GROUP BY g "
+                "ORDER BY g", return_futures=False)
+    df = pd.DataFrame({"g": ["b", "a", "b"] * 100 + ["c", "b"] * 150,
+                       "v": np.arange(600, dtype=np.float64)})
+    exp = df.groupby("g").agg(n=("v", "count"), s=("v", "sum")).reset_index()
+    np.testing.assert_array_equal(got["g"], exp["g"])
+    np.testing.assert_array_equal(got["n"], exp["n"])
+    np.testing.assert_allclose(got["s"], exp["s"])
+
+
+def test_chunked_inside_scalar_subquery_rejected(tpch_pair):
+    _, ck, _ = tpch_pair
+    with pytest.raises(StreamingUnsupported, match="scalar subquery"):
+        ck.sql("SELECT s_suppkey FROM supplier WHERE s_suppkey > "
+               "(SELECT AVG(l_suppkey) FROM lineitem)")
