@@ -63,6 +63,11 @@ def load() -> Optional[ctypes.CDLL]:
     return _lib
 
 
+def available() -> bool:
+    """True when the native parser library is loadable (CI gate)."""
+    return load() is not None
+
+
 def parse_to_json(sql: str) -> Optional[dict]:
     """Parse via the native library; returns the decoded JSON envelope.
 

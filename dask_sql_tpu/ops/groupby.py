@@ -15,8 +15,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..table import dict_sort_order, Column, Scalar, Table
-from ..types import SqlType, physical_dtype
-from .kernels import factorize_columns
+from ..types import SqlType, exact_decimal_scale, physical_dtype
+from .kernels import decimal_unscale, factorize_columns
 
 
 def group_codes(key_cols: List[Column]):
@@ -32,6 +32,25 @@ def _masked(col: Column, extra_mask: Optional[jax.Array]):
     if extra_mask is not None:
         valid = valid & extra_mask
     return data, valid
+
+
+def _decimal_exact_result(op: str, s_int, count, dscale: int,
+                          out_type: SqlType) -> Column:
+    """Shared tail of the exact scaled-int64 SUM/$SUM0/AVG paths: unscale
+    via the exact-quotient route and apply the SQL NULL rules (SUM over no
+    rows -> NULL, $SUM0 -> 0, AVG -> NULL)."""
+    has_any = count > 0
+    if op in ("SUM", "$SUM0"):
+        s = decimal_unscale(s_int, dscale).astype(physical_dtype(out_type))
+        return Column(s, out_type, None if op == "$SUM0" else has_any)
+    mean = s_int.astype(jnp.float64) / (jnp.maximum(count, 1) * 10.0 ** dscale)
+    return Column(mean, out_type, has_any)
+
+
+def _decimal_scaled_ints(data, dscale: int):
+    """Round f64 decimal data onto its integer grid (int64 'cents')."""
+    return jnp.round(data.astype(jnp.float64) * 10.0 ** dscale
+                     ).astype(jnp.int64)
 
 
 def segment_aggregate(op: str, col: Optional[Column], codes: Optional[jax.Array],
@@ -61,6 +80,13 @@ def segment_aggregate(op: str, col: Optional[Column], codes: Optional[jax.Array]
 
     if op in ("SUM", "$SUM0", "AVG", "STDDEV", "STDDEV_POP", "STDDEV_SAMP",
               "VAR_POP", "VAR_SAMP", "VARIANCE"):
+        dscale = exact_decimal_scale(col.stype) if op in ("SUM", "$SUM0",
+                                                          "AVG") else None
+        if dscale is not None:
+            # exact scaled-int64 money math: order-independent, bit-stable
+            iwork = jnp.where(valid, _decimal_scaled_ints(data, dscale), 0)
+            s_int = jax.ops.segment_sum(iwork, codes, num_groups)
+            return _decimal_exact_result(op, s_int, count, dscale, out_type)
         work = data.astype(jnp.float64) if not jnp.issubdtype(data.dtype, jnp.integer) else data.astype(jnp.int64)
         work = jnp.where(valid, work, 0)
         s = jax.ops.segment_sum(work, codes, num_groups)
@@ -235,6 +261,13 @@ def sorted_segment_aggregate(op: str, col_sorted: Optional[Column],
 
     if op in ("SUM", "$SUM0", "AVG", "STDDEV", "STDDEV_POP", "STDDEV_SAMP",
               "VAR_POP", "VAR_SAMP", "VARIANCE"):
+        dscale = exact_decimal_scale(col_sorted.stype) if op in (
+            "SUM", "$SUM0", "AVG") else None
+        if dscale is not None:
+            idata = _decimal_scaled_ints(data, dscale)
+            s_int = sa.seg_sum(idata, valid_sorted, codes_sorted, starts,
+                               ends).astype(jnp.int64)
+            return _decimal_exact_result(op, s_int, count, dscale, out_type)
         s = sa.seg_sum(data, valid_sorted, codes_sorted, starts, ends)
         if op == "SUM":
             return Column(s.astype(physical_dtype(out_type)), out_type, has_any)
@@ -321,6 +354,12 @@ def whole_table_aggregate(op: str, col: Optional[Column],
 
     if op in ("SUM", "$SUM0", "AVG", "STDDEV", "STDDEV_POP", "STDDEV_SAMP",
               "VAR_POP", "VAR_SAMP", "VARIANCE"):
+        dscale = exact_decimal_scale(col.stype) if op in ("SUM", "$SUM0",
+                                                          "AVG") else None
+        if dscale is not None:
+            iwork = jnp.where(valid, _decimal_scaled_ints(data, dscale), 0)
+            s_int = jnp.sum(iwork).reshape(1)
+            return _decimal_exact_result(op, s_int, count, dscale, out_type)
         if jnp.issubdtype(data.dtype, jnp.floating):
             work = jnp.where(valid, data.astype(jnp.float64), 0.0)
         else:

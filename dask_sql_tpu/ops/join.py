@@ -48,7 +48,8 @@ def _expand_matches(lcodes: jax.Array, rcodes: jax.Array
 
 def join_tables(left: Table, right: Table, left_keys: List[int],
                 right_keys: List[int], join_type: str,
-                null_aware_anti: bool = False) -> Tuple[Table, Optional[jax.Array]]:
+                null_aware_anti: bool = False,
+                null_equal: bool = False) -> Tuple[Table, Optional[jax.Array]]:
     """Equi-join two tables.
 
     Returns (joined_table, matched_pair_row_origin) where the joined table has
@@ -61,6 +62,7 @@ def join_tables(left: Table, right: Table, left_keys: List[int],
         lcodes, rcodes = join_key_codes(
             [left.columns[i] for i in left_keys],
             [right.columns[i] for i in right_keys],
+            null_equal=null_equal,
         )
     else:
         # cross join: all pairs

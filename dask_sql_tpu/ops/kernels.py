@@ -95,12 +95,15 @@ def factorize_columns(cols: List[Column], *, null_as_group: bool = True
     return codes, first, num_groups
 
 
-def join_key_codes(left: List[Column], right: List[Column]
-                   ) -> Tuple[jax.Array, jax.Array]:
+def join_key_codes(left: List[Column], right: List[Column],
+                   null_equal: bool = False) -> Tuple[jax.Array, jax.Array]:
     """Factorize left+right key columns on a shared domain.
 
     Returns int64 codes for each side; -1 marks rows with NULL keys (never
-    match, reference join.py:220-235).
+    match, reference join.py:220-235).  ``null_equal=True`` switches to
+    set-operation equality (SQL "IS NOT DISTINCT FROM"): NULL gets its own
+    shared code and matches NULL — INTERSECT/EXCEPT require it (a row
+    (NULL, 'x') present on both sides IS in the intersection).
     """
     nl = len(left[0]) if left else 0
     combined_cols = []
@@ -125,7 +128,11 @@ def join_key_codes(left: List[Column], right: List[Column]
         uniq, inv = jnp.unique(data, return_inverse=True)
         inv = inv.reshape(-1).astype(jnp.int64)
         if mask is not None:
-            inv = jnp.where(mask, inv, -1)
+            if null_equal:
+                # NULL becomes code 0, one shared bucket; real values shift
+                inv = jnp.where(mask, inv + 1, 0)
+            else:
+                inv = jnp.where(mask, inv, -1)
         per.append(inv)
 
     combined = per[0]
@@ -301,6 +308,23 @@ def canon_f64(x: jax.Array) -> jax.Array:
     return jnp.where(jnp.isnan(x), 0.0, x)
 
 
+
+
+def decimal_unscale(s_int: jax.Array, scale: int) -> jax.Array:
+    """Correctly-rounded ``s_int / 10**scale`` under jit.
+
+    XLA rewrites division by a constant into multiplication by its (inexact)
+    reciprocal, which mis-rounds the final decimal result by one ulp
+    (observed on XLA:CPU: 2505363390/100 -> ...3633.900000002). Splitting
+    into an exact integer quotient plus a sub-unit remainder keeps any
+    reciprocal error far below the result's rounding granularity.
+    """
+    if scale == 0:
+        return s_int.astype(jnp.float64)
+    f = 10 ** scale
+    q = s_int // f
+    r = s_int - q * f
+    return q.astype(jnp.float64) + r.astype(jnp.float64) / float(f)
 
 
 def orderable_int64(x: jax.Array) -> jax.Array:

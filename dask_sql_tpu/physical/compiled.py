@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import hashlib
 import logging
+import math
 import os
 import weakref
 from collections import OrderedDict
@@ -729,12 +730,21 @@ class _Tracer:
         out_cols: List[Column] = _decode_static_keys(key_cols, key_meta,
                                                      domain)
 
+        from ..types import exact_decimal_scale
+
         mxu_rows = [kmask.astype(jnp.float64)]  # row 0: occupancy counts
         slots = []
         for j, agg in enumerate(rel.aggs):
             f = rel.schema[len(rel.group_keys) + j]
             col = src.table.columns[agg.args[0]] if agg.args else None
             fmask = self._agg_filter(agg, src)
+            # exact decimal money math rides the MXU too: integer-valued
+            # f64 matmuls are exact below 2^53 (SF100 cents sums ~6e15)
+            factor = 1.0
+            if col is not None and agg.op in ("SUM", "$SUM0", "AVG"):
+                ds = exact_decimal_scale(col.stype)
+                if ds is not None:
+                    factor = 10.0 ** ds
             if col is None:
                 vmask = jnp.ones(n, bool) if fmask is None else fmask
                 vrow = vmask.astype(jnp.float64)
@@ -742,9 +752,12 @@ class _Tracer:
             else:
                 vmask = col.valid_mask() if fmask is None \
                     else (col.valid_mask() & fmask)
-                vrow = jnp.where(vmask, col.data.astype(jnp.float64), 0.0)
+                data = col.data.astype(jnp.float64)
+                if factor != 1.0:
+                    data = jnp.round(data * factor)
+                vrow = jnp.where(vmask, data, 0.0)
                 crow = vmask.astype(jnp.float64)
-            slots.append((j, agg, f, len(mxu_rows)))
+            slots.append((j, agg, f, len(mxu_rows), factor))
             mxu_rows.append(vrow)
             mxu_rows.append(crow)
 
@@ -754,19 +767,26 @@ class _Tracer:
 
         from ..types import physical_dtype
         results: List[Optional[Column]] = [None] * len(rel.aggs)
-        for j, agg, f, row0 in slots:
+        for j, agg, f, row0, factor in slots:
             sums, counts = red[row0], red[row0 + 1]
             has = counts > 0
             if agg.op == "COUNT":
                 results[j] = Column(counts.astype(jnp.int64), f.stype, None)
-            elif agg.op == "$SUM0":
+            elif agg.op in ("$SUM0", "SUM"):
+                out = sums
+                if factor != 1.0:
+                    # MXU sums of scaled decimals are integer-valued f64
+                    # (exact below 2^53): unscale via the exact-quotient
+                    # path, not a reciprocal-rewritten division
+                    from ..ops.kernels import decimal_unscale
+                    out = decimal_unscale(
+                        sums.astype(jnp.int64),
+                        int(round(math.log10(factor))))
                 results[j] = Column(
-                    sums.astype(physical_dtype(f.stype)), f.stype, None)
-            elif agg.op == "SUM":
-                results[j] = Column(
-                    sums.astype(physical_dtype(f.stype)), f.stype, has)
+                    out.astype(physical_dtype(f.stype)), f.stype,
+                    None if agg.op == "$SUM0" else has)
             else:  # AVG
-                results[j] = Column(sums / jnp.maximum(counts, 1.0),
+                results[j] = Column(sums / (jnp.maximum(counts, 1.0) * factor),
                                     f.stype, has)
         out_cols.extend(results)
         return _VT(Table(out_names, out_cols), occupancy)

@@ -342,8 +342,11 @@ def _intersect(rel: LogicalIntersect, ex: RelExecutor) -> Table:
     a = ex.execute(rel.inputs_[0])
     b = ex.execute(rel.inputs_[1])
     a = a.take(G.distinct_rows(a.columns))
+    # set-op equality: NULL matches NULL (IS NOT DISTINCT FROM) — a plain
+    # equi-join would silently drop every NULL-bearing row (r2 oracle find)
     out, _ = J.join_tables(a, b, list(range(a.num_columns)),
-                           list(range(b.num_columns)), "SEMI")
+                           list(range(b.num_columns)), "SEMI",
+                           null_equal=True)
     return out.with_names([f.name for f in rel.schema])
 
 
@@ -352,7 +355,8 @@ def _except(rel: LogicalExcept, ex: RelExecutor) -> Table:
     b = ex.execute(rel.inputs_[1])
     a = a.take(G.distinct_rows(a.columns))
     out, _ = J.join_tables(a, b, list(range(a.num_columns)),
-                           list(range(b.num_columns)), "ANTI")
+                           list(range(b.num_columns)), "ANTI",
+                           null_equal=True)
     return out.with_names([f.name for f in rel.schema])
 
 

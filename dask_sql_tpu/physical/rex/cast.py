@@ -74,6 +74,18 @@ def _format_value(v, stype: SqlType) -> str:
 
 def cast_column(col: Column, target: SqlType) -> Column:
     sn, tn = col.stype.name, target.name
+    if tn == "DECIMAL" and col.stype.is_numeric and target.scale is not None \
+            and 0 <= target.scale <= 9 and not (
+                sn == "DECIMAL" and col.stype.scale == target.scale):
+        # CAST to DECIMAL(p, s) QUANTIZES (rounds to s decimals) so the
+        # scaled-int64 exact-aggregation contract holds on the values.
+        # Rounding is jnp.round = half-even over the f64 representation —
+        # the reference's pandas substrate behaves identically (and our
+        # ROUND op matches); a true decimal engine's half-up can differ by
+        # one unit in the last place on exact halves.
+        f = 10.0 ** target.scale
+        data = jnp.round(col.data.astype(jnp.float64) * f) / f
+        return Column(data, target, col.mask)
     if sn == tn or (col.stype.is_string and target.is_string):
         return Column(col.data, target, col.mask, col.dictionary)
     if col.stype.is_string:

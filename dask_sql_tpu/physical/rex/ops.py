@@ -130,6 +130,12 @@ def sql_div(a, b):
 def _py_div(a, b):
     if isinstance(a, int) and isinstance(b, int):
         return int(a / b) if b != 0 else None
+    if b == 0:
+        # match the COLUMN path's IEEE semantics (jnp a/0.0 -> ±inf, 0/0 ->
+        # nan; the reference's pandas substrate does the same) instead of
+        # raising ZeroDivisionError on the scalar-literal path
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return float(np.float64(a) / np.float64(b))
     return a / b
 
 
@@ -598,17 +604,42 @@ def like_op(kind: str):
         if pattern.is_null or (isinstance(expr, Scalar) and expr.is_null):
             n = _length(args)
             return all_null_column(n, BOOLEAN) if n is not None else Scalar(None, BOOLEAN)
-        rx = (sql_similar_to_regex(str(pattern.value), escape) if kind == "SIMILAR"
-              else sql_like_to_regex(str(pattern.value), escape))
-        flags = re.IGNORECASE if kind == "ILIKE" else 0
-        compiled = re.compile(rx, flags)
+        pat = str(pattern.value)
+
+        def _regex_bitmap(d):
+            rx = (sql_similar_to_regex(pat, escape) if kind == "SIMILAR"
+                  else sql_like_to_regex(pat, escape))
+            flags = re.IGNORECASE if kind == "ILIKE" else 0
+            compiled = re.compile(rx, flags)
+            return np.array([compiled.match(s) is not None for s in d])
+
         if isinstance(expr, Scalar):
-            return Scalar(compiled.match(str(expr.value)) is not None, BOOLEAN)
-        d = expr.dictionary.astype(str) if expr.stype.is_string else expr.to_numpy().astype(str)
-        per = np.array([compiled.match(s) is not None for s in d])
+            return Scalar(bool(_regex_bitmap([str(expr.value)])[0]), BOOLEAN)
+        from ...ops.strings_fast import (DEVICE_STRING_THRESHOLD,
+                                         device_like_bitmap, dict_as_str,
+                                         like_bitmap_vectorized)
         if expr.stype.is_string:
+            dct = expr.dictionary
+            if (len(dct) >= DEVICE_STRING_THRESHOLD
+                    and not getattr(ctx, "is_tracer", False)):
+                # past the dictionary cliff: chunk matching runs on device
+                # over the memoized bytes matrix (not under trace — the
+                # matrix must stay a runtime buffer, not a baked constant)
+                per_dev = device_like_bitmap(dct, pat, escape, kind)
+                if per_dev is not None:
+                    out = jnp.take(per_dev,
+                                   jnp.clip(expr.data, 0, len(dct) - 1))
+                    return Column(out, BOOLEAN, expr.mask)
+            d = dict_as_str(dct)
+            per = like_bitmap_vectorized(d, pat, escape, kind)
+            if per is None:
+                per = _regex_bitmap(d)
             out = jnp.take(jnp.asarray(per), jnp.clip(expr.data, 0, len(d) - 1))
             return Column(out, BOOLEAN, expr.mask)
+        d = expr.to_numpy().astype(str)
+        per = like_bitmap_vectorized(d, pat, escape, kind)
+        if per is None:
+            per = _regex_bitmap(d)
         return Column(jnp.asarray(per), BOOLEAN, expr.mask)
 
     return op

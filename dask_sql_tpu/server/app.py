@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 import uuid as uuid_mod
 from concurrent.futures import Future, ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -26,15 +27,78 @@ logger = logging.getLogger(__name__)
 # presto wire responses (reference server/responses.py)
 # ---------------------------------------------------------------------------
 
-def _stats(state: str) -> dict:
-    """Placeholder stats, parity with reference responses.py:11-49."""
-    return {
-        "state": state, "queued": False, "scheduled": False, "nodes": 0,
-        "totalSplits": 0, "queuedSplits": 0, "runningSplits": 0,
-        "completedSplits": 0, "cpuTimeMillis": 0, "wallTimeMillis": 0,
+def _stats(state: str, info: Optional["_QueryInfo"] = None) -> dict:
+    """Wire-shape of reference responses.py:11-49, but FILLED: the reference
+    hardcodes zeros; here cpu/wall/queued times, processed rows/bytes, the
+    compile-vs-cache-hit split and device peak memory come from the actual
+    execution (physical/compiled.py stats + timers)."""
+    out = {
+        "state": state, "queued": state == "QUEUED", "scheduled": True,
+        "nodes": 1, "totalSplits": 1, "queuedSplits": int(state == "QUEUED"),
+        "runningSplits": int(state == "RUNNING"),
+        "completedSplits": int(state == "FINISHED"),
+        "cpuTimeMillis": 0, "wallTimeMillis": 0,
         "queuedTimeMillis": 0, "elapsedTimeMillis": 0, "processedRows": 0,
         "processedBytes": 0, "peakMemoryBytes": 0,
     }
+    if info is not None:
+        now = time.monotonic()
+        started = info.started or now
+        finished = info.finished or now
+        out["queuedTimeMillis"] = int(1000 * (started - info.submitted))
+        out["wallTimeMillis"] = int(1000 * max(finished - started, 0))
+        out["elapsedTimeMillis"] = int(1000 * (finished - info.submitted))
+        out["cpuTimeMillis"] = int(1000 * info.cpu_sec)
+        out["processedRows"] = info.rows
+        out["processedBytes"] = info.bytes
+        out["peakMemoryBytes"] = info.peak_memory
+        out["compiledPrograms"] = info.compiles
+        out["programCacheHits"] = info.cache_hits
+    return out
+
+
+class _QueryInfo:
+    __slots__ = ("submitted", "started", "finished", "cpu_sec", "rows",
+                 "bytes", "peak_memory", "compiles", "cache_hits")
+
+    def __init__(self):
+        self.submitted = time.monotonic()
+        self.started = None
+        self.finished = None
+        self.cpu_sec = 0.0
+        self.rows = 0
+        self.bytes = 0
+        self.peak_memory = 0
+        self.compiles = 0
+        self.cache_hits = 0
+
+
+def _run_tracked(context, sql: str, info: _QueryInfo):
+    from ..physical import compiled
+
+    info.started = time.monotonic()
+    c0 = dict(compiled.stats)
+    # thread_time, not process_time: concurrent pool queries must not
+    # inflate each other's cpu accounting
+    cpu0 = time.thread_time()
+    try:
+        table = context.sql(sql)
+    finally:
+        info.cpu_sec = time.thread_time() - cpu0
+        info.finished = time.monotonic()
+        info.compiles = compiled.stats["compiles"] - c0["compiles"]
+        info.cache_hits = compiled.stats["hits"] - c0["hits"]
+    if table is not None and getattr(table, "num_columns", 0):
+        info.rows = table.num_rows
+        info.bytes = sum(int(getattr(c.data, "nbytes", 0))
+                         for c in table.columns)
+    try:
+        import jax
+        mem = jax.local_devices()[0].memory_stats() or {}
+        info.peak_memory = int(mem.get("peak_bytes_in_use", 0))
+    except Exception:
+        pass
+    return table
 
 
 _TYPE_MAP = {
@@ -81,6 +145,7 @@ class _AppState:
         self.context = context
         self.pool = ThreadPoolExecutor(max_workers=4)
         self.future_list: Dict[str, Future] = {}
+        self.query_info: Dict[str, _QueryInfo] = {}
         self.lock = threading.Lock()
 
 
@@ -111,23 +176,27 @@ def _make_handler(state: _AppState, base_url: str):
                 if fut is None:
                     self._send(404, _error_payload("Unknown query id", uid))
                     return
+                info = state.query_info.get(uid)
                 if not fut.done():
                     self._send(200, {
                         "id": uid, "infoUri": base_url,
                         "nextUri": f"{base_url}/v1/status/{uid}",
                         "partialCancelUri": f"{base_url}/v1/cancel/{uid}",
-                        "stats": _stats("RUNNING"),
+                        "stats": _stats("RUNNING", info),
                     })
                     return
                 try:
                     table = fut.result()
                 except Exception as e:
                     del state.future_list[uid]
+                    state.query_info.pop(uid, None)
                     self._send(200, _error_payload(str(e), uid))
                     return
                 del state.future_list[uid]
+                state.query_info.pop(uid, None)
                 payload = {
-                    "id": uid, "infoUri": base_url, "stats": _stats("FINISHED"),
+                    "id": uid, "infoUri": base_url,
+                    "stats": _stats("FINISHED", info),
                 }
                 if table is not None and table.num_columns:
                     payload["columns"] = _columns_payload(table)
@@ -144,13 +213,15 @@ def _make_handler(state: _AppState, base_url: str):
             length = int(self.headers.get("Content-Length", 0))
             sql = self.rfile.read(length).decode()
             uid = str(uuid_mod.uuid4())
-            fut = state.pool.submit(state.context.sql, sql)
+            info = _QueryInfo()
+            state.query_info[uid] = info
+            fut = state.pool.submit(_run_tracked, state.context, sql, info)
             state.future_list[uid] = fut
             self._send(200, {
                 "id": uid, "infoUri": base_url,
                 "nextUri": f"{base_url}/v1/status/{uid}",
                 "partialCancelUri": f"{base_url}/v1/cancel/{uid}",
-                "stats": _stats("QUEUED"),
+                "stats": _stats("QUEUED", info),
             })
 
         # DELETE /v1/cancel/{uuid}
@@ -158,6 +229,7 @@ def _make_handler(state: _AppState, base_url: str):
             if self.path.startswith("/v1/cancel/"):
                 uid = self.path[len("/v1/cancel/"):].strip("/")
                 fut = state.future_list.pop(uid, None)
+                state.query_info.pop(uid, None)
                 if fut is None:
                     self._send(404, _error_payload("Unknown query id", uid))
                     return

@@ -417,6 +417,33 @@ def host_encode_numpy(values: np.ndarray, stype: Optional[SqlType] = None,
     SORTED global dictionary for string columns (shared across batches so
     every batch compiles to the same program)."""
     values = np.asarray(values)
+    if values.dtype.kind == "O" and (stype is None or not stype.is_string):
+        import decimal as _decimal
+
+        isna = np.array([v is None or (isinstance(v, float)
+                                       and np.isnan(v)) for v in values])
+        present = values[~isna]
+        if len(present) and all(isinstance(v, _decimal.Decimal)
+                                and v.is_finite() for v in present):
+            # ALL-finite decimal.Decimal columns ingest as DECIMAL(18, s):
+            # f64 storage + a typed scale, so SUM/AVG take the exact
+            # scaled-int64 path (types.exact_decimal_scale). Mixed or
+            # non-finite object columns keep the generic path.
+            scale = 0
+            for v in present:
+                scale = max(scale, -int(v.as_tuple().exponent))
+            data = np.array([0.0 if na else float(v)
+                             for v, na in zip(values, isna)], dtype=np.float64)
+            m = (~isna if mask is None
+                 else (np.asarray(mask, bool) & ~isna))
+            if m.all():
+                m = None
+            from .types import decimal as _mk_decimal
+            if scale > 9:
+                # outside the exact-int64 envelope: typed honestly (so the
+                # exact path declines) and values stay unquantized f64
+                return data, m, _mk_decimal(38, scale), None
+            return data, m, _mk_decimal(18, scale), None
     if stype is None:
         stype = sql_type_from_numpy(values.dtype)
     if values.dtype.kind in ("O", "U", "S") or stype.is_string:

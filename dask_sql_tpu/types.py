@@ -99,6 +99,24 @@ def decimal(precision: int = 38, scale: int = 0) -> SqlType:
     return SqlType("DECIMAL", precision, scale)
 
 
+def exact_decimal_scale(stype: SqlType):
+    """Scale for EXACT scaled-int64 aggregation, or None.
+
+    DECIMAL(p<=18, 0<=s<=9) sums fit int64 at any realistic row count
+    (SF100 money sums are ~6e15 'cents' < 2^53 < 2^63): SUM/AVG over such
+    columns accumulate in integers — bit-stable across runs and matching a
+    true decimal engine exactly, unlike the f64 fold the reference uses
+    (mappings.py:64 maps DECIMAL to float64 end to end).
+    """
+    if stype.name != "DECIMAL" or stype.scale is None:
+        return None
+    if not (0 <= stype.scale <= 9):
+        return None
+    if stype.precision is not None and stype.precision > 18:
+        return None
+    return stype.scale
+
+
 # ---------------------------------------------------------------------------
 # logical type -> physical numpy dtype (device representation)
 # ---------------------------------------------------------------------------

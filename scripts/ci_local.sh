@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Executable form of .github/workflows/test.yml for environments without a
+# GitHub runner (this image). Runs the same four jobs in sequence:
+#   1. native parser build from source + load check
+#   2. full suite, single device
+#   3. distributed suites on the 8-device virtual CPU mesh
+#   4. bare `pip install .` import smoke test (native fallback path)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== [1/4] native build ==="
+make -C native clean all
+python -c "from dask_sql_tpu.native import available; assert available()"
+
+echo "=== [2/4] full suite (single device) ==="
+python -m pytest tests/ -q
+
+echo "=== [3/4] mesh suites (8 virtual devices) ==="
+python -m pytest tests/integration/test_distributed.py \
+                 tests/integration/test_tpch_mesh.py -q
+
+echo "=== [4/4] bare install smoke ==="
+TMPDIR=$(mktemp -d)
+pip install --quiet --target "$TMPDIR/site" . >/dev/null
+(cd /tmp && PYTHONPATH="$TMPDIR/site" python - <<'EOF'
+import jax; jax.config.update('jax_platforms', 'cpu')
+import pandas as pd
+from dask_sql_tpu import Context
+c = Context()
+c.create_table('t', pd.DataFrame({'a': [1, 2, 3]}))
+out = c.sql('SELECT SUM(a) AS s FROM t', return_futures=False)
+assert int(out['s'][0]) == 6, out
+print('bare install OK')
+EOF
+)
+rm -rf "$TMPDIR"
+echo "=== CI green ==="

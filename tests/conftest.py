@@ -20,7 +20,20 @@ import jax
 # virtual 8-device CPU mesh — config.update wins over the plugin registration
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+
+# the persistent cache must be per-CPU-microarchitecture: XLA:CPU AOT
+# executables from another machine SEGFAULT on load (observed: /tmp reused
+# across hosts -> "machine features ... not supported", then SIGSEGV in
+# get_executable_and_time)
+import hashlib as _hashlib
+
+try:
+    with open("/proc/cpuinfo") as _f:
+        _flags = "".join(sorted(l for l in _f if l.startswith("flags")))
+    _cpu_fp = _hashlib.blake2b(_flags.encode(), digest_size=4).hexdigest()
+except OSError:
+    _cpu_fp = "nocpuinfo"
+jax.config.update("jax_compilation_cache_dir", f"/tmp/jax_test_cache_{_cpu_fp}")
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
 
@@ -182,6 +195,16 @@ def assert_eq(result, expected, check_row_order: bool = True, **kwargs):
         result = result.to_pandas()
     got = _normalize(result)
     exp = _normalize(expected)
+    # an all-NULL aggregate lands as float64 NaN on one side and as an
+    # object-dtype None on the other (pd.read_sql): both mean SQL NULL
+    for col in got.columns:
+        if col not in exp.columns:
+            continue
+        g, e = got[col], exp[col]
+        if g.dtype == object and e.dtype.kind == "f" and g.isna().all():
+            got[col] = g.astype("float64")
+        elif e.dtype == object and g.dtype.kind == "f" and e.isna().all():
+            exp[col] = e.astype("float64")
     if not check_row_order:
         got = got.sort_values(by=list(got.columns), na_position="last").reset_index(drop=True)
         exp = exp.sort_values(by=list(exp.columns), na_position="last").reset_index(drop=True)

@@ -1,0 +1,188 @@
+"""Golden semantics oracle: the type-sensitive edges SQLite cannot judge.
+
+The reference double-oracles against PostgreSQL in docker
+(/root/reference/tests/integration/fixtures.py:188-288, test_postgres.py)
+precisely because SQLite is weak on NULL-ordering defaults, division,
+date arithmetic and rounding.  No postgres exists in this image, so these
+are GOLDEN tests: expected values derived from the SQL standard /
+PostgreSQL semantics (or, where the reference's pandas substrate
+intentionally diverges, from the reference's behavior — noted inline).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+
+
+@pytest.fixture()
+def c():
+    ctx = Context()
+    ctx.create_table("t", pd.DataFrame({
+        "x": [3.0, 1.0, None, 2.0],
+        "i": [-7, 7, 5, -5],
+        "s": ["b", None, "a", "c"],
+        "d": pd.to_datetime(["1994-01-31", "1994-03-15",
+                             "1996-02-29", "1994-12-31"]),
+    }))
+    return ctx
+
+
+def _col(ctx, sql, col=0):
+    return ctx.sql(sql, return_futures=False).iloc[:, col].tolist()
+
+
+class TestNullOrderingDefaults:
+    """PostgreSQL/Calcite: NULLs sort as LARGER than every value — last
+    under ASC, first under DESC (SQLite does the opposite for ASC, which is
+    why it cannot judge this)."""
+
+    def test_asc_default_nulls_last(self, c):
+        got = c.sql("SELECT x FROM t ORDER BY x", return_futures=False)
+        vals = got["x"].tolist()
+        assert vals[:3] == [1.0, 2.0, 3.0] and pd.isna(vals[3])
+
+    def test_desc_default_nulls_first(self, c):
+        got = c.sql("SELECT x FROM t ORDER BY x DESC", return_futures=False)
+        vals = got["x"].tolist()
+        assert pd.isna(vals[0]) and vals[1:] == [3.0, 2.0, 1.0]
+
+    def test_explicit_overrides(self, c):
+        vals = _col(c, "SELECT x FROM t ORDER BY x ASC NULLS FIRST")
+        assert pd.isna(vals[0]) and vals[1:] == [1.0, 2.0, 3.0]
+        vals = _col(c, "SELECT x FROM t ORDER BY x DESC NULLS LAST")
+        assert vals[:3] == [3.0, 2.0, 1.0] and pd.isna(vals[3])
+
+    def test_string_nulls(self, c):
+        vals = _col(c, "SELECT s FROM t ORDER BY s")
+        assert vals[:3] == ["a", "b", "c"] and pd.isna(vals[3])
+
+
+class TestDivisionSemantics:
+    """SQL integer division truncates toward zero; MOD takes the sign of
+    the dividend (PostgreSQL). SQLite agrees on these but returns NULL for
+    x/0 where the standard raises — we follow the reference's pandas/IEEE
+    substrate for float/0 (±inf, nan)."""
+
+    def test_integer_division_truncates_toward_zero(self, c):
+        assert _col(c, "SELECT -7/2 AS q") == [-3]
+        assert _col(c, "SELECT 7/-2 AS q") == [-3]
+        assert _col(c, "SELECT CAST(i/2 AS BIGINT) AS q FROM t") == [-3, 3, 2, -2]
+
+    def test_mod_sign_of_dividend(self, c):
+        assert _col(c, "SELECT MOD(-7, 2) AS m") == [-1]
+        assert _col(c, "SELECT MOD(7, -2) AS m") == [1]
+        assert _col(c, "SELECT MOD(i, 3) AS m FROM t") == [-1, 1, 2, -2]
+
+    def test_float_division_by_zero_ieee(self, c):
+        r = c.sql("SELECT 1/0.0 AS pinf, -1/0.0 AS ninf",
+                  return_futures=False)
+        assert np.isposinf(r["pinf"][0]) and np.isneginf(r["ninf"][0])
+
+    def test_decimal_literal_division(self, c):
+        # DECIMAL literals: scale preserved through division (f64 substrate)
+        r = _col(c, "SELECT 0.3 / 0.1 AS q")
+        assert abs(r[0] - 3.0) < 1e-12
+
+
+class TestRoundingSemantics:
+    """numpy/pandas half-even rounding — the REFERENCE's substrate
+    (dask-sql lowers ROUND to the pandas/numpy round, mappings.py's f64
+    DECIMAL compromise). PostgreSQL numeric would round half away from
+    zero; the reference intentionally does not, and parity follows the
+    reference."""
+
+    def test_half_even(self, c):
+        assert _col(c, "SELECT ROUND(0.5) AS r") == [0.0]
+        assert _col(c, "SELECT ROUND(1.5) AS r") == [2.0]
+        assert _col(c, "SELECT ROUND(2.5) AS r") == [2.0]
+        assert _col(c, "SELECT ROUND(-0.5) AS r") == [-0.0]
+
+    def test_round_to_digits(self, c):
+        assert _col(c, "SELECT ROUND(1.234, 2) AS r") == [1.23]
+        assert _col(c, "SELECT ROUND(x, 0) AS r FROM t WHERE x IS NOT NULL"
+                    ) == [3.0, 1.0, 2.0]
+
+    def test_ceil_floor(self, c):
+        r = c.sql("SELECT CEIL(1.1) AS a, FLOOR(-1.1) AS b, CEIL(-1.1) AS c2,"
+                  " FLOOR(1.9) AS d", return_futures=False)
+        assert r.values.tolist() == [[2.0, -2.0, -1.0, 1.0]]
+
+
+class TestDateArithmetic:
+    """Month arithmetic clamps to month end (PostgreSQL: Jan 31 + 1 mon =
+    Feb 28); leap years honored; intervals compose."""
+
+    def test_add_month_clamps(self, c):
+        got = _col(c, "SELECT d + INTERVAL '1' MONTH AS m FROM t")
+        assert [str(v)[:10] for v in got] == [
+            "1994-02-28", "1994-04-15", "1996-03-29", "1995-01-31"]
+
+    def test_add_year_leap_clamp(self, c):
+        got = _col(c, "SELECT d + INTERVAL '1' YEAR AS y FROM t")
+        # 1996-02-29 + 1 year -> 1997-02-28 (clamped, not Mar 1)
+        assert str(got[2])[:10] == "1997-02-28"
+
+    def test_day_interval_exact(self, c):
+        got = _col(c, "SELECT d + INTERVAL '60' DAY AS y FROM t")
+        assert str(got[0])[:10] == "1994-04-01"
+
+    def test_extract_fields(self, c):
+        r = c.sql("SELECT EXTRACT(YEAR FROM d) AS y, EXTRACT(MONTH FROM d) "
+                  "AS m, EXTRACT(DAY FROM d) AS dd, EXTRACT(QUARTER FROM d) "
+                  "AS q FROM t", return_futures=False)
+        assert r["y"].tolist() == [1994, 1994, 1996, 1994]
+        assert r["m"].tolist() == [1, 3, 2, 12]
+        assert r["dd"].tolist() == [31, 15, 29, 31]
+        assert r["q"].tolist() == [1, 1, 1, 4]
+
+    def test_date_comparison_boundary(self, c):
+        # DATE literal vs timestamp comparison at midnight boundary
+        got = _col(c, "SELECT COUNT(*) AS n FROM t "
+                      "WHERE d >= DATE '1994-03-15'")
+        assert got == [3]
+
+
+class TestAggregateEdges:
+    """Aggregates over zero rows: SUM/AVG/MIN/MAX -> NULL, COUNT -> 0
+    (standard; both oracles agree, pinned here because the compiled path
+    short-circuits empty groups differently)."""
+
+    def test_global_aggregates_over_empty(self, c):
+        r = c.sql("SELECT SUM(x) AS s, AVG(x) AS a, MIN(x) AS mn, "
+                  "MAX(x) AS mx, COUNT(x) AS cnt, COUNT(*) AS n "
+                  "FROM t WHERE x > 100", return_futures=False)
+        assert pd.isna(r["s"][0]) and pd.isna(r["a"][0])
+        assert pd.isna(r["mn"][0]) and pd.isna(r["mx"][0])
+        assert r["cnt"][0] == 0 and r["n"][0] == 0
+
+    def test_aggregates_skip_nulls(self, c):
+        r = c.sql("SELECT SUM(x) AS s, COUNT(x) AS cx, COUNT(*) AS n, "
+                  "AVG(x) AS a FROM t", return_futures=False)
+        assert r["s"][0] == 6.0 and r["cx"][0] == 3
+        assert r["n"][0] == 4 and abs(r["a"][0] - 2.0) < 1e-12
+
+    def test_sum_all_nulls_is_null(self, c):
+        r = c.sql("SELECT SUM(x) AS s FROM t WHERE x IS NULL",
+                  return_futures=False)
+        assert pd.isna(r["s"][0])
+
+
+class TestThreeValuedLogic:
+    def test_null_comparisons_are_unknown(self, c):
+        # x <> NULL is UNKNOWN -> filtered; NOT(UNKNOWN) is UNKNOWN too
+        assert _col(c, "SELECT COUNT(*) AS n FROM t WHERE x <> 99") == [3]
+        assert _col(c, "SELECT COUNT(*) AS n FROM t "
+                       "WHERE NOT (x <> 99)") == [0]
+
+    def test_and_or_with_unknown(self, c):
+        # UNKNOWN OR TRUE = TRUE; UNKNOWN AND TRUE = UNKNOWN (filtered)
+        assert _col(c, "SELECT COUNT(*) AS n FROM t "
+                       "WHERE x > 0 OR i > 0") == [4]
+        assert _col(c, "SELECT COUNT(*) AS n FROM t "
+                       "WHERE x > 0 AND i < 10") == [3]
+
+    def test_not_in_with_null_in_list(self, c):
+        # i NOT IN (5, NULL): never TRUE for non-matching rows (UNKNOWN)
+        assert _col(c, "SELECT COUNT(*) AS n FROM t "
+                       "WHERE i NOT IN (5, CAST(NULL AS BIGINT))") == [0]

@@ -81,3 +81,19 @@ def test_cancel(server):
 def test_aggregate_via_server(server):
     payload = _run_to_completion(server, "SELECT SUM(a) AS s FROM df")
     assert payload["data"] == [[6]]
+
+
+def test_stats_filled(server):
+    """The reference returns hardcoded zero stats (responses.py:11-49);
+    ours must carry real execution telemetry (VERDICT r1 item 7)."""
+    payload = _run_to_completion(server, "SELECT a, COUNT(*) AS n FROM df "
+                                         "GROUP BY a")
+    stats = payload["stats"]
+    assert stats["state"] == "FINISHED"
+    assert stats["processedRows"] == 3
+    assert stats["processedBytes"] > 0
+    assert stats["elapsedTimeMillis"] >= stats["wallTimeMillis"] >= 0
+    assert stats["cpuTimeMillis"] >= 0
+    # compile/cache split is present and consistent: the query ran through
+    # the compiled pipeline exactly once (either fresh compile or hit)
+    assert stats["compiledPrograms"] + stats["programCacheHits"] >= 1

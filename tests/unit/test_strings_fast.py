@@ -1,0 +1,105 @@
+"""strings_fast: the vectorized and device LIKE bitmaps must agree with the
+regex transpiler (physical/rex/ops.py sql_like_to_regex) on every pattern
+they accept — differential, over adversarial string sets."""
+import re
+
+import numpy as np
+import pytest
+
+from dask_sql_tpu.ops.strings_fast import (
+    device_like_bitmap, like_bitmap_vectorized, parse_like_chunks,
+)
+from dask_sql_tpu.physical.rex.ops import sql_like_to_regex
+
+STRINGS = np.array([
+    "", "a", "ab", "abc", "abcabc", "xabcy", "aabbcc", "abab",
+    "hello world", "worldly", "special requests", "specialrequests",
+    "xx special yy requests zz", "requests special", "%", "a%b", "a_b",
+    "ABC", "AbC", "ivory blue", "blue ivory", "MEDIUM POLISHED TIN",
+    "PROMO BRUSHED STEEL", "Customer on Complaints", "CustomerComplaints",
+], dtype=object)
+
+# the device path refuses dictionaries with >128-byte strings; keep a
+# separate long entry for the cap test
+LONG_STRINGS = np.append(STRINGS, np.array(["ab" * 70], dtype=object))
+
+PATTERNS = [
+    "%", "%%", "abc", "%abc", "abc%", "%abc%", "a%c", "%a%c%", "a%b%c",
+    "%special%requests%", "ivory%", "%BRASS", "MEDIUM POLISHED%",
+    "%Customer%Complaints%", "", "%a", "b%", "%ab%ab%", "abcabc",
+    "x\\%y", "a\\%b",
+]
+
+
+def _regex_bitmap(d, pattern, escape, flags=0):
+    rx = re.compile(sql_like_to_regex(pattern, escape), flags)
+    return np.array([rx.match(s) is not None for s in d])
+
+
+@pytest.mark.parametrize("pattern", PATTERNS)
+def test_vectorized_matches_regex(pattern):
+    escape = "\\" if "\\" in pattern else None
+    d = STRINGS.astype(str)
+    got = like_bitmap_vectorized(d, pattern, escape, "LIKE")
+    assert got is not None
+    exp = _regex_bitmap(d, pattern, escape)
+    np.testing.assert_array_equal(got, exp, err_msg=pattern)
+
+
+@pytest.mark.parametrize("pattern", PATTERNS)
+def test_device_matches_regex(pattern):
+    escape = "\\" if "\\" in pattern else None
+    got = device_like_bitmap(STRINGS, pattern, escape, "LIKE")
+    assert got is not None
+    exp = _regex_bitmap([str(s) for s in STRINGS], pattern, escape)
+    np.testing.assert_array_equal(np.asarray(got), exp, err_msg=pattern)
+
+
+def test_ilike_paths():
+    d = STRINGS.astype(str)
+    for pattern in ("%abc%", "ABC", "%promo%", "a%C"):
+        exp = _regex_bitmap(d, pattern, None, re.IGNORECASE)
+        vec = like_bitmap_vectorized(d, pattern, None, "ILIKE")
+        np.testing.assert_array_equal(vec, exp, err_msg=pattern)
+        dev = device_like_bitmap(STRINGS, pattern, None, "ILIKE")
+        np.testing.assert_array_equal(np.asarray(dev), exp, err_msg=pattern)
+
+
+def test_underscore_and_similar_rejected():
+    assert parse_like_chunks("a_c", None) is None
+    d = STRINGS.astype(str)
+    assert like_bitmap_vectorized(d, "a_c", None, "LIKE") is None
+    assert like_bitmap_vectorized(d, "a%c", None, "SIMILAR") is None
+    assert device_like_bitmap(STRINGS, "a_c", None, "LIKE") is None
+
+
+def test_long_strings_fall_off_device_path():
+    d = np.array(["x" * 200, "abc"], dtype=object)
+    assert device_like_bitmap(d, "%abc%", None, "LIKE") is None
+    # vectorized path has no length cap
+    got = like_bitmap_vectorized(d.astype(str), "%abc%", None, "LIKE")
+    np.testing.assert_array_equal(got, [False, True])
+
+
+def test_random_differential():
+    rng = np.random.RandomState(0)
+    alphabet = list("abcx%")
+    d = np.array(["".join(rng.choice(list("abcxy"), rng.randint(0, 12)))
+                  for _ in range(300)], dtype=object)
+    for _ in range(40):
+        pattern = "".join(rng.choice(alphabet, rng.randint(0, 8)))
+        exp = _regex_bitmap(d.astype(str), pattern, None)
+        vec = like_bitmap_vectorized(d.astype(str), pattern, None, "LIKE")
+        np.testing.assert_array_equal(vec, exp, err_msg=repr(pattern))
+        dev = device_like_bitmap(d, pattern, None, "LIKE")
+        np.testing.assert_array_equal(np.asarray(dev), exp,
+                                      err_msg=repr(pattern))
+
+
+def test_device_chunk_longer_than_dictionary_strings():
+    d = np.array(["abcd", "efgh"], dtype=object)
+    got = device_like_bitmap(d, "%this-is-way-longer-than-any-entry%",
+                             None, "LIKE")
+    np.testing.assert_array_equal(np.asarray(got), [False, False])
+    got = device_like_bitmap(d, "longer-than-entries", None, "LIKE")
+    np.testing.assert_array_equal(np.asarray(got), [False, False])
