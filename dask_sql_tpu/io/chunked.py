@@ -96,12 +96,31 @@ class ChunkedSource:
         global dictionaries, pass 2 encodes row groups into host batches."""
         import pyarrow.parquet as pq
 
+        import pyarrow.types as patypes
+
+        def _needs_global_dict(t) -> bool:
+            # Any arrow type whose pandas conversion yields object values
+            # must share ONE dictionary across row groups, or merged batches
+            # decode against piece-0 codes (silent wrong results).  Covers
+            # string/large_string/string_view, binary/large_binary/
+            # fixed_size_binary/binary_view, and dictionary-of-any.
+            for pred in ("is_string", "is_large_string", "is_string_view",
+                         "is_binary", "is_large_binary",
+                         "is_fixed_size_binary", "is_binary_view",
+                         "is_dictionary"):
+                fn = getattr(patypes, pred, None)
+                if fn is not None and fn(t):
+                    return True
+            return False
+
         pf = pq.ParquetFile(path)
         schema = pf.schema_arrow
-        str_cols = [f.name for f in schema
-                    if str(f.type) in ("string", "large_string", "utf8",
-                                       "large_utf8")
-                    or str(f.type).startswith("dictionary")]
+        for f in schema:
+            if patypes.is_nested(f.type):
+                raise ValueError(
+                    f"from_parquet: column {f.name!r} has nested arrow type "
+                    f"{f.type} — not representable as a columnar SQL type")
+        str_cols = [f.name for f in schema if _needs_global_dict(f.type)]
         from ..table import string_uniques
 
         uniques = {c: [] for c in str_cols}
@@ -126,6 +145,19 @@ class ChunkedSource:
             return ChunkedSource.from_pandas(df, batch_rows=batch_rows)
         source = pieces[0]
         for extra in pieces[1:]:
+            for ci, name in enumerate(source.names):
+                a, b = source.dictionaries[ci], extra.dictionaries[ci]
+                if a is b:
+                    continue
+                if (a is None) != (b is None) or (
+                        a is not None and not np.array_equal(a, b)):
+                    # A column type slipped past _needs_global_dict and got
+                    # per-piece local dictionaries; mixing their codes would
+                    # silently decode wrong values.
+                    raise ValueError(
+                        f"from_parquet: column {name!r} produced differing "
+                        "per-piece dictionaries; its arrow type needs a "
+                        "global dictionary pass")
             source.batches.extend(extra.batches)
             source.n_rows += extra.n_rows
         # iter_batches can emit a short non-final batch at row-group edges;

@@ -425,13 +425,19 @@ def host_encode_numpy(values: np.ndarray, stype: Optional[SqlType] = None,
         present = values[~isna]
         if len(present) and all(isinstance(v, _decimal.Decimal)
                                 and v.is_finite() for v in present):
-            # ALL-finite decimal.Decimal columns ingest as DECIMAL(18, s):
-            # f64 storage + a typed scale, so SUM/AVG take the exact
-            # scaled-int64 path (types.exact_decimal_scale). Mixed or
-            # non-finite object columns keep the generic path.
+            # ALL-finite decimal.Decimal columns ingest as DECIMAL(p, s)
+            # with p measured from the data: f64 storage + a typed scale, so
+            # SUM/AVG take the exact scaled-int64 path when every value fits
+            # the f64 mantissa exactly (types.exact_decimal_scale gates at
+            # p<=15 since 10^15 < 2^53).  Mixed or non-finite object columns
+            # keep the generic path.
             scale = 0
+            int_digits = 1
             for v in present:
-                scale = max(scale, -int(v.as_tuple().exponent))
+                t = v.as_tuple()
+                scale = max(scale, -int(t.exponent))
+                int_digits = max(int_digits, len(t.digits) + int(t.exponent))
+            precision = int_digits + scale
             data = np.array([0.0 if na else float(v)
                              for v, na in zip(values, isna)], dtype=np.float64)
             m = (~isna if mask is None
@@ -439,11 +445,11 @@ def host_encode_numpy(values: np.ndarray, stype: Optional[SqlType] = None,
             if m.all():
                 m = None
             from .types import decimal as _mk_decimal
-            if scale > 9:
-                # outside the exact-int64 envelope: typed honestly (so the
-                # exact path declines) and values stay unquantized f64
-                return data, m, _mk_decimal(38, scale), None
-            return data, m, _mk_decimal(18, scale), None
+            if scale > 9 or precision > 15:
+                # outside the exact-int64/f64-mantissa envelope: typed
+                # honestly (so the exact path declines), unquantized f64
+                return data, m, _mk_decimal(max(precision, 16), scale), None
+            return data, m, _mk_decimal(15, scale), None
     if stype is None:
         stype = sql_type_from_numpy(values.dtype)
     if values.dtype.kind in ("O", "U", "S") or stype.is_string:
@@ -471,10 +477,23 @@ def host_encode_numpy(values: np.ndarray, stype: Optional[SqlType] = None,
     return values.astype(dtype, copy=False), mask, stype, None
 
 
+def _decode_bytes_objects(values: np.ndarray) -> np.ndarray:
+    """bytes values become str via utf-8/surrogateescape so binary columns
+    behave as strings end to end (SQL literals are strings; repr-strings
+    like \"b'aa'\" would leak otherwise).  Must be applied identically in
+    the dictionary pass and the encode pass to stay self-consistent."""
+    if any(isinstance(v, (bytes, bytearray)) for v in values):
+        values = np.array(
+            [v.decode("utf-8", "surrogateescape")
+             if isinstance(v, (bytes, bytearray)) else v for v in values],
+            dtype=object)
+    return values
+
+
 def string_uniques(values: np.ndarray) -> np.ndarray:
     """Sorted unique strings of an object array (NULLs -> \"\"), the shared
     null-semantics for ingestion and the chunked reader's dictionary pass."""
-    values = np.asarray(values, dtype=object)
+    values = _decode_bytes_objects(np.asarray(values, dtype=object))
     isna = np.array([v is None or (isinstance(v, float) and np.isnan(v))
                      for v in values])
     safe = np.where(isna, "", values).astype(str)
@@ -483,16 +502,26 @@ def string_uniques(values: np.ndarray) -> np.ndarray:
 
 def _host_encode_strings(values: np.ndarray, mask: Optional[np.ndarray],
                          dictionary: Optional[np.ndarray] = None):
-    values = np.asarray(values, dtype=object)
+    values = _decode_bytes_objects(np.asarray(values, dtype=object))
     isna = np.array([v is None or (isinstance(v, float) and np.isnan(v)) for v in values])
     safe = np.where(isna, "", values).astype(str)
     if dictionary is None:
         dictionary, codes = np.unique(safe, return_inverse=True)
         dictionary = dictionary.astype(object)
     else:
-        # shared global dictionary (sorted): encode via binary search; every
-        # value is guaranteed present by the two-pass chunked reader
-        codes = np.searchsorted(dictionary.astype(str), safe)
+        # shared global dictionary (sorted): encode via binary search.  The
+        # two-pass chunked reader guarantees membership; verify anyway — an
+        # absent value would silently take a neighbor's code otherwise.
+        dict_str = dictionary.astype(str)
+        codes = np.searchsorted(dict_str, safe)
+        clipped = np.clip(codes, 0, len(dict_str) - 1)
+        if not np.array_equal(dict_str[clipped], safe):
+            missing = np.unique(safe[dict_str[clipped] != safe])[:5]
+            raise ValueError(
+                "string batch contains values absent from the shared "
+                f"dictionary (first few: {missing.tolist()!r}); the "
+                "dictionary pass missed this column's values")
+        codes = clipped
     codes = codes.astype(np.int32)
     if isna.any():
         m = ~isna if mask is None else (np.asarray(mask, bool) & ~isna)

@@ -102,17 +102,22 @@ def decimal(precision: int = 38, scale: int = 0) -> SqlType:
 def exact_decimal_scale(stype: SqlType):
     """Scale for EXACT scaled-int64 aggregation, or None.
 
-    DECIMAL(p<=18, 0<=s<=9) sums fit int64 at any realistic row count
+    DECIMAL(p<=15, 0<=s<=9) sums fit int64 at any realistic row count
     (SF100 money sums are ~6e15 'cents' < 2^53 < 2^63): SUM/AVG over such
     columns accumulate in integers — bit-stable across runs and matching a
     true decimal engine exactly, unlike the f64 fold the reference uses
     (mappings.py:64 maps DECIMAL to float64 end to end).
+
+    The precision gate is 15, not 18: values are STORED as f64, so an
+    individual value must be exactly representable in the 53-bit mantissa
+    (10^15 < 2^53 < 10^16) or the scaled-int conversion already misrounds
+    before any summation happens.
     """
     if stype.name != "DECIMAL" or stype.scale is None:
         return None
     if not (0 <= stype.scale <= 9):
         return None
-    if stype.precision is not None and stype.precision > 18:
+    if stype.precision is not None and stype.precision > 15:
         return None
     return stype.scale
 
