@@ -1,0 +1,131 @@
+"""Optional-dependency integrations with the dependency faked: intake
+catalog ingestion (reference input_utils/intake.py:14-34) and the IPython
+CodeMirror syntax-highlighting payload (reference integrations/ipython.py:91-133).
+"""
+import sys
+import types
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+
+
+@pytest.fixture()
+def fake_intake(monkeypatch):
+    """A minimal stand-in for the intake package: Catalog is a dict of
+    entries whose .read() returns a pandas frame."""
+    intake = types.ModuleType("intake")
+    catalog_mod = types.ModuleType("intake.catalog")
+
+    class Source:
+        def __init__(self, df, **kwargs):
+            self.df = df
+            self.kwargs = kwargs
+
+        def __call__(self, **kwargs):
+            return Source(self.df, **kwargs)
+
+        def read(self):
+            return self.df
+
+    class Catalog:
+        def __init__(self):
+            self._entries = {}
+
+        def __setitem__(self, k, v):
+            self._entries[k] = v
+
+        def __getitem__(self, k):
+            return self._entries[k]
+
+    catalog_mod.Catalog = Catalog
+    intake.catalog = catalog_mod
+    opened = {}
+
+    def open_catalog(path, **kwargs):
+        opened["path"] = path
+        opened["kwargs"] = kwargs
+        cat = Catalog()
+        cat["t"] = Source(pd.DataFrame({"a": [1, 2, 3], "b": ["x", "y", "x"]}))
+        return cat
+
+    intake.open_catalog = open_catalog
+    monkeypatch.setitem(sys.modules, "intake", intake)
+    monkeypatch.setitem(sys.modules, "intake.catalog", catalog_mod)
+    return intake, Catalog, Source, opened
+
+
+def test_intake_catalog_object_ingestion(fake_intake):
+    _, Catalog, Source, _ = fake_intake
+    cat = Catalog()
+    cat["sales"] = Source(pd.DataFrame({"v": [1.0, 2.0, 4.0]}))
+    c = Context()
+    c.create_table("sales", cat)
+    out = c.sql("SELECT SUM(v) AS s FROM sales", return_futures=False)
+    assert float(out["s"][0]) == 7.0
+
+
+def test_intake_catalog_path_with_format(fake_intake):
+    _, _, _, opened = fake_intake
+    c = Context()
+    c.create_table("t", "/some/catalog.yaml", format="intake",
+                   intake_table_name="t",
+                   catalog_kwargs={"ttl": 60})
+    assert opened["path"] == "/some/catalog.yaml"
+    assert opened["kwargs"] == {"ttl": 60}
+    out = c.sql("SELECT b, COUNT(*) AS n FROM t GROUP BY b ORDER BY b",
+                return_futures=False)
+    assert out["n"].tolist() == [2, 1]
+
+
+def test_highlighting_mime_type_tracks_live_registry():
+    from dask_sql_tpu.integrations.ipython import (highlighting_js,
+                                                   highlighting_mime_type)
+    from dask_sql_tpu.physical.rex.ops import OPERATION_MAPPING
+
+    mime = highlighting_mime_type()
+    # every live operator is a highlighted keyword (lowercased)
+    for op in OPERATION_MAPPING:
+        assert mime["keywords"].get(str(op).lower()), op
+    assert mime["builtin"].get("varchar")
+    assert mime["atoms"] == {"false": True, "true": True, "null": True}
+    js = highlighting_js()
+    assert "text/x-dasksql" in js and "CodeMirror.defineMIME" in js
+
+
+def test_ipython_magic_registers_and_highlights(monkeypatch):
+    registered = {}
+    shipped = {}
+
+    magic_mod = types.ModuleType("IPython.core.magic")
+
+    def register_line_cell_magic(fn):
+        registered["fn"] = fn
+        return fn
+
+    magic_mod.register_line_cell_magic = register_line_cell_magic
+    display_mod = types.ModuleType("IPython.core.display")
+    display_mod.display_javascript = (
+        lambda js, raw=False: shipped.update(js=js, raw=raw))
+    core_mod = types.ModuleType("IPython.core")
+    core_mod.magic = magic_mod
+    core_mod.display = display_mod
+    ipython_mod = types.ModuleType("IPython")
+    ipython_mod.core = core_mod
+    ipython_mod.get_ipython = lambda: None
+    for name, mod in [("IPython", ipython_mod), ("IPython.core", core_mod),
+                      ("IPython.core.magic", magic_mod),
+                      ("IPython.core.display", display_mod)]:
+        monkeypatch.setitem(sys.modules, name, mod)
+
+    from dask_sql_tpu.integrations.ipython import ipython_integration
+
+    c = Context()
+    c.create_table("t", pd.DataFrame({"a": np.arange(4)}))
+    ipython_integration(c)
+    assert registered["fn"].__name__ == "sql"
+    assert shipped["raw"] is True and "text/x-dasksql" in shipped["js"]
+    out = registered["fn"]("SELECT COUNT(*) AS n FROM t")
+    assert out["n"].tolist() == [4]
