@@ -589,6 +589,68 @@ def _direct_info(raw: Optional[jax.Array], valid: jax.Array, size: int):
     return raw, lo, fits
 
 
+def _combined_int_key(part_sides):
+    """Mixed-radix combination of 2+ non-float key parts into ONE int64.
+
+    ``part_sides``: per key part, a list of (data, flag_or_None, valid)
+    triples — one per SIDE (group-by passes one side; joins pass build and
+    probe, so radix ranges come from the union of both).  Per-part runtime
+    ranges become radix strides; nullability flags ride as an extra binary
+    digit.  Returns (keys: one i64 array per side, ok[traced bool scalar],
+    span_prod[traced f64]) — ``ok`` means every stride product stayed
+    below 2^62, making the combination INJECTIVE, so ``_mix64(key)`` is a
+    collision-free hash and the key qualifies for direct addressing when
+    ``span_prod`` also fits the table.  Where ~ok the combined values are
+    meaningless and callers must keep the generic hash + raw verification.
+    None when any part is floating (ranges don't express float equality
+    classes).
+    """
+    for sides in part_sides:
+        for d, _, _ in sides:
+            if jnp.issubdtype(d.dtype, jnp.floating):
+                return None
+    i64 = jnp.iinfo(jnp.int64)
+    n_sides = len(part_sides[0])
+    keys = [jnp.zeros(part_sides[0][s][0].shape[0], dtype=jnp.int64)
+            for s in range(n_sides)]
+    span_prod = jnp.float64(1.0)
+    ok = jnp.bool_(True)
+    for sides in part_sides:
+        lo = jnp.int64(i64.max)
+        hi = jnp.int64(i64.min)
+        any_v = jnp.bool_(False)
+        svalids = []
+        for d, flag, valid in sides:
+            d = d.astype(jnp.int64)
+            sv = valid if flag is None else (valid & (flag == 1))
+            svalids.append(sv)
+            lo = jnp.minimum(lo, jnp.min(jnp.where(sv, d, i64.max)))
+            hi = jnp.maximum(hi, jnp.max(jnp.where(sv, d, i64.min)))
+            any_v = any_v | sv.any()
+        lo = jnp.where(any_v, lo, 0)
+        hi = jnp.where(any_v, hi, 0)
+        span_prod = span_prod * (hi.astype(jnp.float64)
+                                 - lo.astype(jnp.float64) + 1.0)
+        ok = ok & (span_prod < 2.0 ** 62)
+        stride = hi - lo + 1
+        has_flag = any(flag is not None for _, flag, _ in sides)
+        if has_flag:
+            span_prod = span_prod * 2.0
+            ok = ok & (span_prod < 2.0 ** 62)
+        for s, (d, flag, _) in enumerate(sides):
+            d = d.astype(jnp.int64)
+            # where ~ok these wrap harmlessly (the caller masks); where
+            # ok, d - lo is in [0, span) and the product fits int64
+            dn = jnp.where(svalids[s], d - lo, 0)
+            k = keys[s] * stride + dn
+            if has_flag:
+                fl = (jnp.ones_like(dn) if flag is None
+                      else flag.astype(jnp.int64))
+                k = k * 2 + fl
+            keys[s] = k
+    return keys, ok, span_prod
+
+
 def _slot_at_round(h: jax.Array, k, size: int, direct) -> jax.Array:
     s = (_mix64(h + (2 * k + 1).astype(jnp.uint64) * _GOLDEN)
          & jnp.uint64(size - 1)).astype(jnp.int32)
@@ -599,17 +661,28 @@ def _slot_at_round(h: jax.Array, k, size: int, direct) -> jax.Array:
     return s
 
 
+_TBL_EMPTY = jnp.iinfo(jnp.int64).max
+_TBL_ROW_MASK = jnp.int64((1 << 32) - 1)
+
+
 def _hash_table_insert(h: jax.Array, valid: jax.Array, size: int,
                        direct=None):
     """Resolve every valid row to one table slot per distinct u64 hash.
 
+    Claims are priority-encoded as ``(round+1) << 32 | row`` and written
+    with ONE scatter-min per round: earlier rounds always beat later ones
+    and the smallest row wins within a round, so occupied slots are
+    permanent and the claim is deterministic — with no table-sized
+    temporary or merge per round (those dominated the profile at 4M-slot
+    tables).
+
     Returns (slot[i32 per row], resident[i32 per row: the hash group's
-    first row, n where unresolved], resolved[bool], table[i32 size-array:
-    resident row id or n], rounds used[traced i32]).
+    first row, n where unresolved], resolved[bool], table[i64 size-array:
+    priority-encoded claim, _TBL_EMPTY where free], rounds used).
     """
     n = h.shape[0]
     n32 = jnp.int32(n)
-    rows = jnp.arange(n, dtype=jnp.int32)
+    rows = jnp.arange(n, dtype=jnp.int64)
 
     def cond(st):
         k, _, _, _, active = st
@@ -618,18 +691,18 @@ def _hash_table_insert(h: jax.Array, valid: jax.Array, size: int,
     def body(st):
         k, table, slot, resident, active = st
         s_k = _slot_at_round(h, k, size, direct)
-        # claim only EMPTY slots (min row id wins, deterministically);
-        # occupied slots are permanent, so earlier residents never change
         idx = jnp.where(active, s_k, size)
-        claims = jnp.full(size, n32).at[idx].min(rows, mode="drop")
-        table = jnp.where(table == n32, claims, table)
-        res = table[s_k]
-        ok = active & (res < n32) & (h[jnp.clip(res, 0, n32 - 1)] == h)
+        val = ((k + 1).astype(jnp.int64) << 32) | rows
+        table = table.at[idx].min(val, mode="drop")
+        tv = table[s_k]
+        res = (tv & _TBL_ROW_MASK).astype(jnp.int32)
+        ok = (active & (tv != _TBL_EMPTY)
+              & (h[jnp.clip(res, 0, n32 - 1)] == h))
         slot = jnp.where(ok, s_k, slot)
         resident = jnp.where(ok, res, resident)
         return k + 1, table, slot, resident, active & ~ok
 
-    st = (jnp.int32(0), jnp.full(size, n32), jnp.zeros(n, jnp.int32),
+    st = (jnp.int32(0), jnp.full(size, _TBL_EMPTY), jnp.zeros(n, jnp.int32),
           jnp.full(n, n32), valid)
     k, table, slot, resident, active = jax.lax.while_loop(cond, body, st)
     return slot, resident, valid & ~active, table, k
@@ -654,6 +727,17 @@ def _group_hashed_codes(key_cols: List[Column],
     size = _hash_table_size(cap)
     single = _single_int_part(parts)
     direct = _direct_info(single, valid, size)
+    combo_ok = None
+    if single is None:
+        combo = _combined_int_key([[(d, flag, valid)] for d, flag in parts])
+        if combo is not None:
+            # multi-part non-float keys: where the runtime radix product
+            # fits, the combination is injective — collision-free mix hash
+            # plus direct addressing when it also fits the table
+            (key,), combo_ok, span_prod = combo
+            h = jnp.where(combo_ok, _mix64(key.astype(jnp.uint64)), h)
+            direct = (key, jnp.int64(0),
+                      combo_ok & (span_prod <= jnp.float64(size)))
     slot, resident, resolved, table, _ = _hash_table_insert(h, valid, size,
                                                             direct)
 
@@ -665,11 +749,18 @@ def _group_hashed_codes(key_cols: List[Column],
             coll = coll | (resolved & (d[rc] != d)).any()
             if flag is not None:
                 coll = coll | (resolved & (flag[rc] != flag)).any()
+        if combo_ok is not None:
+            # an injective combined key cannot collide; the raw check only
+            # matters where the combination overflowed
+            coll = coll & ~combo_ok
     # else: _mix64 over one int part is a bijection — collisions impossible
 
-    used = table != n
-    dense = jnp.cumsum(used.astype(jnp.int64)) - 1       # slot -> dense id
-    real_groups = jnp.sum(used.astype(jnp.int64))
+    # dense codes in first-occurrence order: rank the LEADER rows (a group's
+    # resident is its first row) and read every row's code through its
+    # resident — all O(n) ops, nothing table-sized
+    leader = resolved & (resident == jnp.arange(n, dtype=resident.dtype))
+    lrank = jnp.cumsum(leader.astype(jnp.int64)) - 1
+    real_groups = jnp.sum(leader.astype(jnp.int64))
     unresolved = (valid & ~resolved).any()
     # congestion (true group count unknowable) reports the impossible value
     # n+1 — _check_flags reads any ng > input rows as "table saturated" and
@@ -677,8 +768,8 @@ def _group_hashed_codes(key_cols: List[Column],
     # the recompiled cap lands tight
     num_groups = jnp.where(unresolved, jnp.int64(n + 1), real_groups)
 
-    codes = jnp.where(resolved, jnp.minimum(dense[slot], cap), cap)
-    leader = resolved & (resident == jnp.arange(n, dtype=resident.dtype))
+    codes_raw = lrank[jnp.clip(resident, 0, n - 1)]
+    codes = jnp.where(resolved, jnp.minimum(codes_raw, cap), cap)
     fr_idx = jnp.where(leader & (codes < cap), codes, cap)
     first_rows = (jnp.full(cap, n, dtype=jnp.int64)
                   .at[fr_idx].min(jnp.arange(n, dtype=jnp.int64),
@@ -1439,6 +1530,7 @@ class _Tracer:
         bij = (len(bparts) == 1
                and jnp.issubdtype(bparts[0][1].dtype, jnp.integer))
         direct_b = direct_p = None
+        combo_ok = None
         if bij:
             braw1 = bparts[0][1].astype(jnp.int64)
             praw1 = pparts[0][1].astype(jnp.int64)
@@ -1447,6 +1539,23 @@ class _Tracer:
             direct_b = _direct_info(braw1, bvalid, size)
             if direct_b is not None:
                 direct_p = (praw1, direct_b[1], direct_b[2])
+        else:
+            # multi-part keys: mixed-radix combination over the UNION of
+            # both sides' runtime ranges — injective where the radix
+            # product fits (combo_ok), giving a collision-free hash and
+            # direct addressing when it also fits the table
+            combo = _combined_int_key(
+                [[(braw, None, bvalid), (praw, None, pvalid)]
+                 for (_, braw), (_, praw) in zip(bparts, pparts)])
+            if combo is not None:
+                (bkey, pkey), combo_ok, span_prod = combo
+                bh = jnp.where(combo_ok,
+                               _mix64(bkey.astype(jnp.uint64)), bh)
+                ph = jnp.where(combo_ok,
+                               _mix64(pkey.astype(jnp.uint64)), ph)
+                fits = combo_ok & (span_prod <= jnp.float64(size))
+                direct_b = (bkey, jnp.int64(0), fits)
+                direct_p = (pkey, jnp.int64(0), fits)
         slot, resident, resolved, table, rounds = _hash_table_insert(
             bh, bvalid, size, direct_b)
 
@@ -1456,6 +1565,10 @@ class _Tracer:
             for _, braw in bparts:
                 raw_mismatch = raw_mismatch | (resolved
                                                & (braw[rc0] != braw)).any()
+            if combo_ok is not None:
+                # injective combined keys cannot collide; the raw check
+                # only matters where the combination overflowed
+                raw_mismatch = raw_mismatch & ~combo_ok
         unresolved = (bvalid & ~resolved).any()
         if jt in ("INNER", "LEFT", "RIGHT"):
             # these require a unique build key (same policy as the sort
@@ -1475,8 +1588,9 @@ class _Tracer:
         def probe_body(st):
             k, cand = st
             s_k = _slot_at_round(ph, k, size, direct_p)
-            r = table[s_k]
-            hit = (r < nb32) & (bh[jnp.clip(r, 0, nb32 - 1)] == ph)
+            tv = table[s_k]
+            r = (tv & _TBL_ROW_MASK).astype(jnp.int32)
+            hit = (tv != _TBL_EMPTY) & (bh[jnp.clip(r, 0, nb32 - 1)] == ph)
             cand = jnp.where((cand == nb32) & hit, r, cand)
             return k + 1, cand
 
@@ -1490,8 +1604,14 @@ class _Tracer:
         cc = jnp.clip(cand, 0, nb - 1)
         match = found & pvalid
         if not bij:
+            raw_eq = jnp.ones(npr, dtype=bool)
             for (_, praw), (_, braw) in zip(pparts, bparts):
-                match = match & (praw == braw[cc])
+                raw_eq = raw_eq & (praw == braw[cc])
+            if combo_ok is not None:
+                # hash equality is key equality where the combination held
+                match = match & (combo_ok | raw_eq)
+            else:
+                match = match & raw_eq
 
         if exist_test is not None:
             # per-slot build aggregates decide "exists build x OP y"
@@ -1503,16 +1623,18 @@ class _Tracer:
                 xd = x_col.data.astype(dt)
                 yd = y_col.data.astype(dt)
             xd, yd = xd.astype(jnp.int64), yd.astype(jnp.int64)
+            # aggregates are indexed by the group's RESIDENT row id (dense
+            # in [0, nb)), not by table slot: nb-sized arrays instead of
+            # table-sized ones, and the probe's candidate IS the resident
             xv = resolved & x_col.valid_mask()
-            idx = jnp.where(xv, slot, size)
+            idx = jnp.where(xv, resident, nb)
             i64 = jnp.iinfo(jnp.int64)
-            cnt = jnp.zeros(size, jnp.int64).at[idx].add(1, mode="drop")
-            mn = (jnp.full(size, i64.max, jnp.int64)
+            cnt = jnp.zeros(nb, jnp.int64).at[idx].add(1, mode="drop")
+            mn = (jnp.full(nb, i64.max, jnp.int64)
                   .at[idx].min(xd, mode="drop"))
-            mx = (jnp.full(size, i64.min, jnp.int64)
+            mx = (jnp.full(nb, i64.min, jnp.int64)
                   .at[idx].max(xd, mode="drop"))
-            sl = slot[cc]
-            cntp, mnp, mxp = cnt[sl], mn[sl], mx[sl]
+            cntp, mnp, mxp = cnt[cc], mn[cc], mx[cc]
             if op_t == "<>":
                 ex = (mnp != yd) | (mxp != yd)
             elif op_t == "<":
@@ -1751,6 +1873,18 @@ def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
     """Execute via the compiled pipeline; None => caller should run eager."""
     if os.environ.get("DSQL_COMPILE", "1") == "0":
         return None
+    from ..ops.pallas_kernels import _on_tpu
+    host_sort = None
+    if not _on_tpu() and isinstance(plan, LogicalSort):
+        # Terminal ORDER BY/LIMIT runs on the HOST off-TPU: the result is
+        # fetched and compacted to its true row count by _materialize
+        # anyway, and sorting those rows costs microseconds, while the
+        # in-program device lexsort pays O(padded n) per collation key
+        # (~8 ms per key per 100k padded rows on XLA:CPU — it dominated
+        # Q2's profile).  On TPU the in-program sort stays: sorts are fast
+        # there and everything before the single fetch should fuse.
+        host_sort = plan
+        plan = plan.input
     scans: list = []
     try:
         plan_fp = _fp_plan(plan, context, scans)
@@ -1758,7 +1892,6 @@ def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
         logger.debug("not compilable: %s", e)
         stats["unsupported"] += 1
         return None
-    from ..ops.pallas_kernels import _on_tpu
     # the backend joins the key: tracing picks backend-specific strategies
     # (merge vs gather join), and with content-based input fingerprints a
     # program — or an _UNSUPPORTED verdict — traced for one backend could
@@ -1835,5 +1968,13 @@ def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
             # the verdict is stable for THESE tables (uid-keyed), so go
             # straight to eager on every future call against them
             _bounded_put(_runtime_eager, runtime_key, True)
+        elif host_sort is not None:
+            from ..ops import sort as S
+            if host_sort.collation:
+                keys = [(c.index, c.ascending, c.effective_nulls_first)
+                        for c in host_sort.collation]
+                result = S.apply_sort(result, keys)
+            result = S.apply_offset_limit(result, host_sort.offset,
+                                          host_sort.limit)
         return result
     return None
