@@ -95,10 +95,10 @@ class Context:
         """
         schema_name = schema_name or self.schema_name
         if chunked:
-            if self.mesh is not None:
-                raise NotImplementedError(
-                    "chunked tables on a mesh: stream batches per host "
-                    "instead (not yet wired)")
+            # composes with mesh= : the streaming executor row-shards each
+            # uploaded batch over the mesh (physical/streaming.py
+            # _set_batch_entry), so execution is out-of-core AND
+            # distributed at once, like the reference's partitioned model
             from .io.chunked import DEFAULT_BATCH_ROWS, ChunkedSource
             rows = batch_rows or DEFAULT_BATCH_ROWS
             if isinstance(input_table, str):

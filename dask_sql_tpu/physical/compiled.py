@@ -553,8 +553,16 @@ _HASH_MAX_ROUNDS = 64
 
 
 def _hash_table_size(n_keys: int) -> int:
-    """Power-of-2 table size at load factor <= 0.25."""
-    return max(16, 1 << int(4 * max(n_keys, 1) - 1).bit_length())
+    """Power-of-2 table size at load factor <= 1/16.
+
+    Generous sizing buys two things off-TPU: fewer claim rounds when
+    hashing, and — the big one — direct addressing for sparse integer
+    keys: TPC-H orderkeys span ~16x the row count, so a 16x table lets
+    `key - lo` resolve in ONE round where a 4x table would fall back to
+    multi-round hashing.  The cost is one table-sized fill (~2 ms at 32 MB
+    on this machine), well under the rounds it saves.
+    """
+    return max(16, 1 << int(16 * max(n_keys, 1) - 1).bit_length())
 
 
 def _single_int_part(parts):

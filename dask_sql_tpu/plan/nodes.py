@@ -244,7 +244,11 @@ class LogicalJoin(RelNode):
         return [self.left, self.right]
 
     def with_inputs(self, inputs):
-        return LogicalJoin(inputs[0], inputs[1], self.join_type, self.condition, self.schema)
+        out = LogicalJoin(inputs[0], inputs[1], self.join_type,
+                          self.condition, self.schema)
+        if hasattr(self, "null_aware"):
+            out.null_aware = self.null_aware  # type: ignore[attr-defined]
+        return out
 
     def _explain_line(self):
         return f"LogicalJoin(condition=[{self.condition!r}], joinType=[{self.join_type.lower()}])"
