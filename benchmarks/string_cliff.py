@@ -53,7 +53,8 @@ def main():
         ctx = Context()
         ctx.create_table("t", df)
 
-        for strategy in ("regex", "vectorized", "device"):
+        for strategy in ("regex", "vectorized", "device",
+                         "device_compiled"):
             if strategy == "regex":
                 # force the r1 path: disable both fast bitmaps
                 patch = {"like_bitmap_vectorized": lambda *a: None,
@@ -62,6 +63,7 @@ def main():
                 patch = {"threshold": 1 << 62}
             else:
                 patch = {"threshold": 0}
+            compiled_run = strategy == "device_compiled"
             saved = (strings_fast.like_bitmap_vectorized,
                      strings_fast.DEVICE_STRING_THRESHOLD)
             if "like_bitmap_vectorized" in patch:
@@ -71,7 +73,8 @@ def main():
             # ops.py imports names at call time from the module, so the
             # patch above is what the engine sees
             try:
-                os.environ["DSQL_COMPILE"] = "0"  # eager: per-QUERY cost
+                if not compiled_run:
+                    os.environ["DSQL_COMPILE"] = "0"  # eager: per-QUERY cost
                 ctx.sql(query)  # warm (dictionary matrix build for device)
                 best = float("inf")
                 for _ in range(reps):

@@ -77,11 +77,14 @@ def join_tables(left: Table, right: Table, left_keys: List[int],
         li, ri, counts = _expand_matches(lcodes, rcodes)
         if null_aware_anti:
             # NOT IN semantics: if the build side contains any NULL key,
-            # nothing qualifies; rows with NULL probe keys never qualify.
+            # nothing qualifies; rows with NULL probe keys qualify only
+            # when the build side is EMPTY (x NOT IN (empty) is TRUE for
+            # every x, NULL included — PostgreSQL/SQLite agree).
             build_has_null = bool((rcodes < 0).any()) if nr else False
             if build_has_null:
                 return left.take(jnp.zeros(0, dtype=jnp.int64)), None
-            keep = mask_to_indices((counts == 0) & (lcodes >= 0))
+            keep = mask_to_indices((counts == 0)
+                                   & ((lcodes >= 0) | (nr == 0)))
         else:
             keep = mask_to_indices(counts == 0)
         return left.take(keep), None

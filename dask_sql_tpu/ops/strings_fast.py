@@ -34,6 +34,8 @@ DEVICE_STRING_THRESHOLD = int(
     os.environ.get("DSQL_DEVICE_STRING_THRESHOLD", str(1 << 15)))
 _MAX_DEVICE_STR_LEN = 128
 
+stats = {"device_bitmaps": 0}   # observability for tests/benchmarks
+
 
 def parse_like_chunks(pattern: str, escape: Optional[str]
                       ) -> Optional[Tuple[List[str], bool, bool]]:

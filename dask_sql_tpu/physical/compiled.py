@@ -140,10 +140,11 @@ def _fp_plan(rel: RelNode, context, scans: list) -> str:
     elif isinstance(rel, LogicalJoin):
         if rel.join_type not in ("INNER", "LEFT", "RIGHT", "SEMI", "ANTI"):
             raise Unsupported(rel.join_type)
-        if getattr(rel, "null_aware", False):
-            raise Unsupported("null-aware anti join")
+        # null-aware anti (NOT IN) compiles too; the flag joins the
+        # fingerprint so it can't share a program with a plain anti join
+        na = "N" if getattr(rel, "null_aware", False) else ""
         cond = ("T" if rel.condition is None else _fp_rex(rel.condition, context, scans))
-        body = f"{rel.join_type}|{cond}"
+        body = f"{rel.join_type}{na}|{cond}"
     elif isinstance(rel, LogicalSort):
         body = (",".join(f"{c.index}{'a' if c.ascending else 'd'}"
                          f"{'nf' if c.effective_nulls_first else 'nl'}"
@@ -1310,8 +1311,19 @@ class _Tracer:
             return _VT(probe.table.with_names(out_names),
                        probe.vmask() & match)
         if jt == "ANTI":
+            keep = ~match
+            if getattr(rel, "null_aware", False):
+                # NOT IN: any NULL key on the build side empties the
+                # result; NULL probe keys qualify only when the build is
+                # EMPTY (x NOT IN (empty) is TRUE for every x — matches
+                # ops/join.py:78-88 and PostgreSQL/SQLite)
+                build_rows = build.vmask()
+                build_has_null = (build_rows & ~bvalid).any()
+                build_nonempty = build_rows.any()
+                keep = (keep & ~build_has_null
+                        & (pvalid | ~build_nonempty))
             return _VT(probe.table.with_names(out_names),
-                       probe.vmask() & ~match)
+                       probe.vmask() & keep)
 
         def _pairs(build_cols: List[Column]) -> Table:
             if probe_is_left:

@@ -620,13 +620,18 @@ def like_op(kind: str):
                                          like_bitmap_vectorized)
         if expr.stype.is_string:
             dct = expr.dictionary
-            if (len(dct) >= DEVICE_STRING_THRESHOLD
-                    and not getattr(ctx, "is_tracer", False)):
+            if len(dct) >= DEVICE_STRING_THRESHOLD:
                 # past the dictionary cliff: chunk matching runs on device
-                # over the memoized bytes matrix (not under trace — the
-                # matrix must stay a runtime buffer, not a baked constant)
+                # over the memoized bytes matrix.  Under the whole-plan
+                # tracer this executes EAGERLY (dct is concrete) and the
+                # resulting D-bool bitmap bakes into the program as a
+                # constant — sound because the program cache is keyed on
+                # dictionary content, and D bools are tiny next to the
+                # bytes matrix itself
                 per_dev = device_like_bitmap(dct, pat, escape, kind)
                 if per_dev is not None:
+                    from ...ops import strings_fast as _sf
+                    _sf.stats["device_bitmaps"] += 1
                     out = jnp.take(per_dev,
                                    jnp.clip(expr.data, 0, len(dct) - 1))
                     return Column(out, BOOLEAN, expr.mask)

@@ -179,6 +179,19 @@ def _register_temp(context, table: Table, row_valid=None) -> LogicalTableScan:
                             schema=fields)
 
 
+def _register_temp_typed(context, table: Table, fields) -> LogicalTableScan:
+    """Register a temp table and return its scan RE-TYPED to ``fields``'
+    stypes (temp registration sanitizes names; ordinals carry meaning)."""
+    return _retype(_register_temp(context, table), fields)
+
+
+def _retype(scan: LogicalTableScan, fields) -> LogicalTableScan:
+    return LogicalTableScan(
+        schema_name=scan.schema_name, table_name=scan.table_name,
+        schema=[Field(f2.name, f1.stype)
+                for f1, f2 in zip(fields, scan.schema)])
+
+
 def _set_batch_entry(context, table: Table, row_valid) -> None:
     if STREAM_SCHEMA not in context.schema:
         context.create_schema(STREAM_SCHEMA)
@@ -223,13 +236,7 @@ def _stream_partial_plans(subtree: RelNode, scan: LogicalTableScan,
                         "a second chunked table feeds the streamed subtree")
                 return rel
             t = _run_resident(rel, context)
-            tmp = _register_temp(context, t)
-            # keep this subtree's field stypes (names are sanitized)
-            tmp = LogicalTableScan(
-                schema_name=tmp.schema_name, table_name=tmp.table_name,
-                schema=[Field(f2.name, f1.stype)
-                        for f1, f2 in zip(rel.schema, tmp.schema)])
-            return tmp
+            return _register_temp_typed(context, t, rel.schema)
         if isinstance(rel, LogicalJoin):
             left_on = any(id(rel.left) == id(p) for p in path) or rel.left is scan
             jt = rel.join_type
@@ -556,11 +563,7 @@ def _stream_aggregate_split(agg: LogicalAggregate, scan, path, source,
         partials = _run_batches(partial_plan, source, context,
                                 dedup_each_batch=True)
         names, cols = _dedup_host(*_concat_host(partials))
-        ptmp = _host_cols_to_temp(names, cols, context)
-        ptmp = LogicalTableScan(
-            schema_name=ptmp.schema_name, table_name=ptmp.table_name,
-            schema=[Field(f2.name, f1.stype)
-                    for f1, f2 in zip(dd_fields, ptmp.schema)])
+        ptmp = _retype(_host_cols_to_temp(names, cols, context), dd_fields)
         final_aggs = [
             AggCall(c.op, [gk], c.distinct, c.stype, c.name)
             for c in agg.aggs]
@@ -587,19 +590,13 @@ def _stream_aggregate_split(agg: LogicalAggregate, scan, path, source,
         # aggregates have one-row-per-batch partials: device merge always)
         logger.info("streaming: %d partial bytes exceed budget; merging "
                     "on host", _partial_bytes(partials))
-        merge = _merge_aggregate_on_host(names, cols, gk, merge_aggs,
-                                         group_fields, context)
-        merge = LogicalTableScan(
-            schema_name=merge.schema_name, table_name=merge.table_name,
-            schema=[Field(f2.name, f1.stype)
-                    for f1, f2 in zip(merge_schema, merge.schema)])
+        merge = _retype(_merge_aggregate_on_host(
+            names, cols, gk, merge_aggs, group_fields, context),
+            merge_schema)
         final: RelNode = merge
     else:
-        ptmp = _host_cols_to_temp(names, cols, context)
-        ptmp = LogicalTableScan(
-            schema_name=ptmp.schema_name, table_name=ptmp.table_name,
-            schema=[Field(f2.name, f1.stype)
-                    for f1, f2 in zip(partial_schema, ptmp.schema)])
+        ptmp = _retype(_host_cols_to_temp(names, cols, context),
+                       partial_schema)
         final = LogicalAggregate(input=ptmp,
                                  group_keys=list(range(gk)),
                                  aggs=merge_aggs, schema=merge_schema)
@@ -628,11 +625,7 @@ def _stream_topk_split(sort: LogicalSort, scan, path, source,
     partials = _run_batches(partial_plan, source, context)
 
     names, cols = _concat_host(partials)
-    ptmp = _host_cols_to_temp(names, cols, context)
-    ptmp = LogicalTableScan(
-        schema_name=ptmp.schema_name, table_name=ptmp.table_name,
-        schema=[Field(f2.name, f1.stype)
-                for f1, f2 in zip(sort.schema, ptmp.schema)])
+    ptmp = _retype(_host_cols_to_temp(names, cols, context), sort.schema)
     final = LogicalSort(input=ptmp, collation=sort.collation,
                         offset=sort.offset, limit=sort.limit,
                         schema=list(sort.schema))
@@ -699,11 +692,7 @@ def _stream_keyset_split(join: LogicalJoin, scan, source, context):
     partials = _run_batches(partial_plan, source, context,
                             dedup_each_batch=True)
     names, cols = _dedup_host(*_concat_host(partials))
-    ptmp = _host_cols_to_temp(names, cols, context)
-    ptmp = LogicalTableScan(
-        schema_name=ptmp.schema_name, table_name=ptmp.table_name,
-        schema=[Field(f2.name, f1.stype)
-                for f1, f2 in zip(dd_fields, ptmp.schema)])
+    ptmp = _retype(_host_cols_to_temp(names, cols, context), dd_fields)
     nl = len(join.left.schema)
     new_cond = (None if join.condition is None
                 else _remap_condition(join.condition, nl, refs))

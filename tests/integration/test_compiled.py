@@ -367,3 +367,28 @@ def test_runtime_verdict_not_inherited_by_reloaded_data(c):
     r = c2.sql(q, return_futures=False)
     assert compiled.stats["fallbacks"] == fb2, "inherited stale exile"
     assert sorted(r["k"].tolist()) == [1, 2, 3, 4]
+
+
+def test_compiled_path_uses_device_string_bitmap(monkeypatch):
+    """Above the dictionary-cardinality threshold the COMPILED path picks
+    the device bytes-matrix LIKE bitmap (r2 left it eager-only): the bitmap
+    computes eagerly at trace time and bakes into the program as a
+    constant, keyed by dictionary content."""
+    import pandas as pd
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.ops import strings_fast
+    from dask_sql_tpu.physical import compiled
+
+    monkeypatch.setattr(strings_fast, "DEVICE_STRING_THRESHOLD", 1)
+    c = Context()
+    c.create_table("t", pd.DataFrame(
+        {"s": ["special requests", "plain", "very special requests here",
+               "nothing"] * 50}))
+    before_dev = strings_fast.stats["device_bitmaps"]
+    before = dict(compiled.stats)
+    out = c.sql("SELECT COUNT(*) AS n FROM t WHERE s LIKE "
+                "'%special%requests%'", return_futures=False)
+    assert out["n"].tolist() == [100]
+    assert compiled.stats["compiles"] > before["compiles"]  # compiled ran
+    assert strings_fast.stats["device_bitmaps"] > before_dev  # device path

@@ -76,6 +76,13 @@ CORPUS = [
      "(SELECT 1 FROM d WHERE d.k = a.k)", True),
     ("in_subquery",
      "SELECT k, v FROM a WHERE k IN (SELECT k FROM d WHERE w > 5)", True),
+    ("not_in",
+     "SELECT k, v FROM a WHERE k NOT IN (SELECT k FROM d WHERE w > 5)",
+     True),
+    ("not_in_empty",
+     # x NOT IN (empty) is TRUE for every x, NULL included
+     "SELECT f FROM a WHERE f NOT IN (SELECT w FROM d WHERE w > 999)",
+     False),  # sqlite's read_sql NULL/float frame shape differs; engine-only
     ("scalar_subquery",
      "SELECT k, v FROM a WHERE v > (SELECT AVG(v) FROM a)", True),
     ("order_limit",
