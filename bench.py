@@ -174,6 +174,19 @@ def _stage_main():
                 mem[k] = int(stats[k])
     except Exception:
         pass
+    # the axon backend exposes no allocator stats; account for at least the
+    # resident table arrays so device_memory is never silently empty
+    try:
+        tbl_bytes = 0
+        for entry in c.schema[c.schema_name].tables.values():
+            tbl = getattr(entry, "table", None)
+            for col in getattr(tbl, "columns", []):
+                tbl_bytes += int(col.data.nbytes)
+                if col.mask is not None:
+                    tbl_bytes += int(col.mask.nbytes)
+        mem.setdefault("table_bytes_resident", tbl_bytes)
+    except Exception:
+        pass
     emit({"stage_done": True, "load_sec": round(load_sec, 1),
           "warmup_sec": round(warmup_sec, 1), "device_memory": mem,
           "compiled_stats": dict(compiled.stats)})
@@ -212,11 +225,31 @@ def main():
     def run_stages(platform_choice, stage_lists, stage_data_dir,
                    budget_end):
         stage_meta = []
+        # STABLE (cross-invocation) compile + caps caches: an XLA program
+        # costs ~40-200 s to compile over the tunneled TPU but loads from
+        # the persistent cache in ~0.3 s, and a capacity-escalation
+        # recompile learned once should never be paid again — so a repeat
+        # bench run (or one primed by an earlier run on the same host)
+        # skips straight to steady state.  Cold runs still work: the
+        # stage layout records partial results as compiles land.
+        uid = os.getuid() if hasattr(os, "getuid") else 0
+        cache_root = os.path.join(
+            tempfile.gettempdir(),
+            f"dsql_bench_cache_{platform_choice}_u{uid}")
+        os.makedirs(cache_root, mode=0o700, exist_ok=True)
+        if hasattr(os, "getuid") and os.stat(cache_root).st_uid != uid:
+            # someone else pre-created the path: don't trust (or feed) a
+            # foreign program cache — fall back to a private dir
+            cache_root = tempfile.mkdtemp(prefix="dsql_bench_cache_")
         env_base = dict(os.environ, BENCH_STAGE="1",
                         BENCH_DATA_DIR=stage_data_dir,
                         BENCH_PROGRESS=progress,
                         BENCH_PLATFORM_CHOICE=platform_choice,
                         BENCH_SF=str(sf))
+        env_base.setdefault("DSQL_XLA_CACHE",
+                            os.path.join(cache_root, "xla"))
+        env_base.setdefault("DSQL_CAPS_FILE",
+                            os.path.join(cache_root, "caps.json"))
         for i, stage in enumerate(stage_lists):
             remaining = budget_end - time.perf_counter()
             if remaining < 60:
@@ -304,10 +337,13 @@ def main():
     for qid in done:
         if time.perf_counter() > p_deadline:
             break
+        fn = PANDAS_QUERIES.get(qid)
+        if fn is None:
+            continue  # engine-only query: vs_baseline covers `based` anyway
         best = float("inf")
         for _ in range(PANDAS_REPS):
             t0 = time.perf_counter()
-            PANDAS_QUERIES[qid](data)
+            fn(data)
             best = min(best, time.perf_counter() - t0)
             if time.perf_counter() > p_deadline:
                 break
@@ -334,7 +370,7 @@ def main():
             "stage_errors": stage_meta,
             "engine_wins": wins,
             "engine_sec": {str(k): round(times[k], 4) for k in done},
-            "pandas_sec": {str(k): round(p_times[k], 4) for k in done},
+            "pandas_sec": {str(k): round(p_times[k], 4) for k in based},
             "pandas_geomean_sec": round(geo_p, 4),
             "gen_sec": round(gen_sec, 1),
             "load_sec": round(load_sec, 1),

@@ -22,6 +22,7 @@ code path.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +38,23 @@ def _on_tpu() -> bool:
         return jax.default_backend() == "tpu"
     except Exception:
         return False
+
+
+def _strategy_on_tpu() -> bool:
+    """Which KERNEL STRATEGY to trace — sort-based merge join / payload-
+    through-sort groupby (TPU-shaped: no scatters) vs hash-table join /
+    scatter groupby (host-shaped: scatters are ~1 ms where sorts are
+    hundreds).  Distinct from ``_on_tpu`` (the hardware truth, which gates
+    pallas ``interpret=``): ``DSQL_STRATEGY=tpu|host`` forces a strategy on
+    either backend — the driver bench uses ``host`` on the tunneled TPU
+    because the merge join's variadic sorts compile ~8x slower there
+    (~200 s/query) while the hash program compiles in ~25 s."""
+    s = os.environ.get("DSQL_STRATEGY", "auto").lower()
+    if s == "tpu":
+        return True
+    if s in ("host", "cpu"):
+        return False
+    return _on_tpu()
 
 
 def _seg_matmul_kernel(codes_ref, mask_ref, vals_ref, out_ref):

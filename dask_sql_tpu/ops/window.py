@@ -113,7 +113,7 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
     if n == 0:
         return Column(jnp.zeros(0, dtype=physical_dtype(stype)), stype)
 
-    from .pallas_kernels import _on_tpu
+    from .pallas_kernels import _strategy_on_tpu as _on_tpu
     on_tpu = _on_tpu()
 
     # 1. sort by (validity, partition, order keys) — trace-safe: partitions

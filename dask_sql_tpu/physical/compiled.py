@@ -314,7 +314,7 @@ def _group_sorted_codes(key_cols: List[Column],
     """
     from ..ops import sorted_agg as sa
 
-    from ..ops.pallas_kernels import _on_tpu
+    from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
 
     n = len(key_cols[0])
     parts = _key_parts(key_cols)
@@ -409,7 +409,7 @@ def _traced_factorize(key_cols: List[Column], row_valid: Optional[jax.Array],
     sorts; there is no ngroups escalation on this path (callers pass
     cap >= the worst case), so an unresolved table folds into the
     collision flag and reruns eager."""
-    from ..ops.pallas_kernels import _on_tpu
+    from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
     if not _on_tpu():
         codes, first, ng, coll = _group_hashed_codes(key_cols, row_valid,
                                                      cap)
@@ -898,7 +898,7 @@ class _Tracer:
         self._agg_counter += 1
         cap = min(self.caps.get(tag, DEFAULT_GROUP_CAP), n)
 
-        from ..ops.pallas_kernels import _on_tpu
+        from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
         if not _on_tpu():
             # CPU/GPU: hash-table codes + scatter segment aggregates — the
             # group sort this path replaces costs ~350 ms at 600k rows on
@@ -1276,7 +1276,7 @@ class _Tracer:
         ph = _hash_parts(pparts, pvalid)
         bh = _hash_parts(bparts, bvalid)
 
-        from ..ops.pallas_kernels import _on_tpu
+        from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
         # The merge join ships every build column (data + mask) as a sort
         # payload channel; past a width cutoff the per-channel O(n log n)
         # sort cost overtakes the probe path's per-column O(n) gathers even
@@ -1724,6 +1724,67 @@ _compile_failures: "OrderedDict[tuple, int]" = OrderedDict()
 _LEARNED_LIMIT = 1024
 _UNSUPPORTED = object()
 
+# Optional write-through persistence for learned group caps
+# (``DSQL_CAPS_FILE=/path.json``): a capacity-escalation recompile is cheap
+# on XLA:CPU but costs 100-200 s per program over the tunneled TPU backend,
+# so caps learned by one process (a bench stage child, a warmup run) must
+# carry to the next.  Keys are hashes of the full program base key — plan
+# fingerprint, input layout fingerprint, strategy — so a cap never applies
+# to a different query, data layout, or backend strategy.
+_caps_disk: Optional[Dict[str, Dict[str, int]]] = None
+
+
+def _caps_disk_key(base_key) -> str:
+    return hashlib.blake2b(repr(base_key).encode(),
+                           digest_size=16).hexdigest()
+
+
+def _caps_disk_read(path: str) -> Dict[str, Dict[str, int]]:
+    import json
+    try:
+        with open(path) as f:
+            loaded = json.load(f)
+        return {k: {t: int(c) for t, c in v.items()}
+                for k, v in loaded.items() if isinstance(v, dict)}
+    except (OSError, ValueError):
+        return {}
+
+
+def _learned_caps_get(base_key) -> Dict[str, int]:
+    caps = _learned_caps.get(base_key)
+    if caps is not None:
+        return dict(caps)
+    path = os.environ.get("DSQL_CAPS_FILE")
+    if not path:
+        return {}
+    global _caps_disk
+    if _caps_disk is None:
+        _caps_disk = _caps_disk_read(path)
+    return dict(_caps_disk.get(_caps_disk_key(base_key), {}))
+
+
+def _learned_caps_put(base_key, caps: Dict[str, int]) -> None:
+    _bounded_put(_learned_caps, base_key, dict(caps))
+    path = os.environ.get("DSQL_CAPS_FILE")
+    if not path:
+        return
+    import json
+    import threading
+    global _caps_disk
+    # read-merge-replace: concurrent writers (threaded warmup) can lose a
+    # race, which only costs one re-learn — never corrupts (atomic replace;
+    # tmp name is per-thread so two warmup threads can't interleave bytes)
+    disk = _caps_disk_read(path)
+    disk[_caps_disk_key(base_key)] = {k: int(v) for k, v in caps.items()}
+    tmp = f"{path}.tmp{os.getpid()}.{threading.get_ident()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(disk, f)
+        os.replace(tmp, path)
+        _caps_disk = disk
+    except OSError:
+        logger.debug("caps file %s not writable", path)
+
 
 def _bounded_put(d: OrderedDict, key, value):
     while len(d) >= _LEARNED_LIMIT:
@@ -1893,7 +1954,7 @@ def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
     """Execute via the compiled pipeline; None => caller should run eager."""
     if os.environ.get("DSQL_COMPILE", "1") == "0":
         return None
-    from ..ops.pallas_kernels import _on_tpu
+    from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
     host_sort = None
     if not _on_tpu() and isinstance(plan, LogicalSort):
         # Terminal ORDER BY/LIMIT runs on the HOST off-TPU: the result is
@@ -1925,7 +1986,7 @@ def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
     if runtime_key in _runtime_eager:
         stats["fallbacks"] += 1
         return None
-    caps: Dict[str, int] = dict(_learned_caps.get(base_key, {}))
+    caps: Dict[str, int] = _learned_caps_get(base_key)
     for _ in range(8):  # capacity-escalation bound
         key = (base_key, tuple(sorted(caps.items())))
         entry = _cache.get(key)
@@ -1981,7 +2042,7 @@ def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
         except _NeedsRecompile as r:
             stats["recompiles"] += 1
             caps = r.caps
-            _bounded_put(_learned_caps, base_key, dict(caps))
+            _learned_caps_put(base_key, caps)
             continue
         if result is None:
             # runtime invariant failed (non-unique build / hash collision):

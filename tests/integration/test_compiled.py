@@ -128,6 +128,35 @@ def test_group_capacity_escalation(c, monkeypatch):
 
 
 @_needs_compiled
+def test_group_caps_persist_to_file(c, monkeypatch, tmp_path):
+    # DSQL_CAPS_FILE write-through: an escalation learned by this "process"
+    # must be found by a cold one (simulated by clearing every in-memory
+    # cache), so the first compile already uses the right capacity — on the
+    # tunneled TPU a recompile costs 100-200 s
+    caps_file = tmp_path / "caps.json"
+    monkeypatch.setenv("DSQL_CAPS_FILE", str(caps_file))
+    monkeypatch.setattr(compiled, "DEFAULT_GROUP_CAP", 2)
+    monkeypatch.setattr(compiled, "_caps_disk", None)
+    # distinct from the escalation test's query: the learned cap survives in
+    # the restored in-memory dict after this test, and sharing a fingerprint
+    # would rob that test of its recompile
+    q = "SELECT b, SUM(a) AS s FROM df GROUP BY b"
+    rec = compiled.stats["recompiles"]
+    c.sql(q)
+    assert compiled.stats["recompiles"] > rec
+    assert caps_file.exists()
+    # cold process: no programs, no in-memory caps — only the file
+    monkeypatch.setattr(compiled, "_cache", type(compiled._cache)())
+    monkeypatch.setattr(compiled, "_learned_caps",
+                        type(compiled._learned_caps)())
+    monkeypatch.setattr(compiled, "_caps_disk", None)
+    rec = compiled.stats["recompiles"]
+    comp, eager = _both_paths(c, q)
+    _assert_same(comp, eager, ordered=False)
+    assert compiled.stats["recompiles"] == rec
+
+
+@_needs_compiled
 def test_runtime_fallback_nonunique_build(c):
     # both sides have duplicate keys -> the unique-build invariant fails at
     # runtime; the flags vector reroutes to the eager executor, which handles
@@ -369,6 +398,7 @@ def test_runtime_verdict_not_inherited_by_reloaded_data(c):
     assert sorted(r["k"].tolist()) == [1, 2, 3, 4]
 
 
+@_needs_compiled
 def test_compiled_path_uses_device_string_bitmap(monkeypatch):
     """Above the dictionary-cardinality threshold the COMPILED path picks
     the device bytes-matrix LIKE bitmap (r2 left it eager-only): the bitmap

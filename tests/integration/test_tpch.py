@@ -81,16 +81,23 @@ def test_tpch_query_matches_sqlite(tpch, qid):
 
 
 @needs_compiled
-def test_all_queries_use_compiled_path(tpch_data, monkeypatch):
+@pytest.mark.parametrize("force_tpu", [False, True],
+                         ids=["native-cpu", "forced-tpu"])
+def test_all_queries_use_compiled_path(tpch_data, monkeypatch, force_tpu):
     """Every TPC-H query must run as ONE compiled program, no eager
-    fallbacks — the merge-join strategy is forced so the TPU path is what
-    gets pinned (the CPU gather strategy rejects Q21's anti-join residual
-    by design). A fresh Context is load-bearing: the program cache keys on
+    fallbacks — certified on BOTH strategies: the native platform's
+    (hash join / hash groupby on this CPU test host — the path the
+    driver's bench records on fallback) and the forced-TPU merge-join
+    path. A fresh Context is load-bearing: the program cache keys on
     table identity, so reusing the oracle fixture's tables could replay
     programs traced before the monkeypatch."""
     from dask_sql_tpu.ops import pallas_kernels
     from dask_sql_tpu.physical import compiled
-    monkeypatch.setattr(pallas_kernels, "_on_tpu", lambda: True)
+    # pin the strategy explicitly: an ambient DSQL_STRATEGY would otherwise
+    # make both variants certify the same path
+    monkeypatch.delenv("DSQL_STRATEGY", raising=False)
+    if force_tpu:
+        monkeypatch.setattr(pallas_kernels, "_on_tpu", lambda: True)
     data = tpch_data
     ctx = Context()
     for name, df in data.items():
