@@ -27,7 +27,11 @@ def _sql_sum(s):
 
 def q1(d):
     li = d["lineitem"]
-    x = li[li["l_shipdate"] <= _TS("1998-09-02")].copy()
+    # narrow before copying: materializing all 16 columns of the ~98%
+    # selectivity filter tripled the runtime at SF 1
+    x = li.loc[li["l_shipdate"] <= _TS("1998-09-02"),
+               ["l_returnflag", "l_linestatus", "l_quantity",
+                "l_extendedprice", "l_discount", "l_tax"]].copy()
     x["disc_price"] = x["l_extendedprice"] * (1 - x["l_discount"])
     x["charge"] = x["disc_price"] * (1 + x["l_tax"])
     out = x.groupby(["l_returnflag", "l_linestatus"], as_index=False).agg(
@@ -368,9 +372,12 @@ def q21(d):
                  right_on="n_nationkey")
     of = od[od["o_orderstatus"] == "F"]
     # per order: number of distinct suppliers overall and among late lines
-    nsupp = li.groupby("l_orderkey")["l_suppkey"].nunique()
+    # (drop_duplicates+size ~3x faster than groupby.nunique at SF 1)
+    nsupp = (li[["l_orderkey", "l_suppkey"]].drop_duplicates()
+             .groupby("l_orderkey").size())
     late = li[li["l_receiptdate"] > li["l_commitdate"]]
-    nsupp_late = late.groupby("l_orderkey")["l_suppkey"].nunique()
+    nsupp_late = (late[["l_orderkey", "l_suppkey"]].drop_duplicates()
+                  .groupby("l_orderkey").size())
     l1 = late.merge(sa[["s_suppkey", "s_name"]], left_on="l_suppkey",
                     right_on="s_suppkey")
     l1 = l1.merge(of[["o_orderkey"]], left_on="l_orderkey",

@@ -1,0 +1,176 @@
+"""Streaming x mesh at scale: the SF-10 out-of-core + distributed proof.
+
+The reference's execution model is out-of-core AND distributed by
+construction (partitioned dask dataframes over a cluster,
+/root/reference/dask_sql/input_utils/convert.py:38-62).  Our equivalent is
+``create_table(chunked=True)`` composed with ``Context(mesh=...)``: each host
+batch is row-sharded over the mesh, the per-batch compiled program runs as a
+GSPMD program, and partials merge by aggregate algebra
+(physical/streaming.py).  This script certifies that composition at a scale
+factor far above anything resident-in-HBM testing covers:
+
+    python benchmarks/streaming_scale.py          # SF 10, Q1/Q3/Q5/Q6/Q9
+    STREAM_SCALE_SF=3 python benchmarks/streaming_scale.py
+
+Equality oracle: the hand-written pandas implementations
+(benchmarks/pandas_tpch.py) — an independent host implementation, itself
+oracle-tested against the engine (tests/integration/test_pandas_oracle.py).
+The engine's own resident path is NOT the oracle here: an 8-thread GSPMD
+program on this 1-core host spends minutes per collective rendezvous.
+
+At SF >= 3 the run writes the certification artifact STREAMING_r03.json at
+the repo root (per-query wall seconds, batch count/bytes, equality
+verdicts); smaller SFs are smoke runs and write /tmp/streaming_smoke.json
+so they can never clobber a certification.  The streaming memory claim is
+the DEVICE working set: at most one ~BATCH_ROWS-row batch resident at a
+time (``batch_device_bytes_approx``) versus the full table a resident run
+uploads (``lineitem_host_bytes``); ``process_peak_rss_gb`` is the whole
+host process — generator and pandas oracle included — recorded only for
+ops visibility, not as an out-of-core proof.
+"""
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np
+import pandas as pd
+
+from benchmarks.tpch import QUERIES, generate_tpch
+from dask_sql_tpu import Context
+
+SF = float(os.environ.get("STREAM_SCALE_SF", "10"))
+QIDS = [int(q) for q in os.environ.get("STREAM_SCALE_QUERIES",
+                                       "1,3,5,6,9").split(",")]
+BATCH_ROWS = int(os.environ.get("STREAM_SCALE_BATCH_ROWS", str(4 << 20)))
+OUT = (os.path.join(os.path.dirname(os.path.dirname(
+           os.path.abspath(__file__))), "STREAMING_r03.json")
+       if SF >= 3 else "/tmp/streaming_smoke.json")
+
+
+def _rss_gb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+
+
+def _frames_equal(a: pd.DataFrame, b: pd.DataFrame) -> bool:
+    if len(a) != len(b) or list(a.columns) != list(b.columns):
+        return False
+    a = a.reset_index(drop=True)
+    b = b.reset_index(drop=True)
+    for col in a.columns:
+        av, bv = a[col], b[col]
+        if pd.api.types.is_float_dtype(av) or pd.api.types.is_float_dtype(bv):
+            if not np.allclose(av.astype(float), bv.astype(float),
+                               rtol=1e-6, atol=1e-9, equal_nan=True):
+                return False
+        elif not (av.astype(str).to_numpy() == bv.astype(str).to_numpy()).all():
+            return False
+    return True
+
+
+def main():
+    t0 = time.perf_counter()
+    data = generate_tpch(SF)
+    gen_sec = time.perf_counter() - t0
+    li_rows = len(data["lineitem"])
+    li_bytes = int(data["lineitem"].memory_usage(deep=False).sum())
+
+    from benchmarks.pandas_tpch import PANDAS_QUERIES
+    from dask_sql_tpu.parallel.mesh import default_mesh
+
+    mesh = default_mesh()
+    mesh_devices = int(mesh.devices.size)
+    chunked = Context(mesh=mesh)
+    t0 = time.perf_counter()
+    for name, frame in data.items():
+        if name == "lineitem":
+            chunked.create_table(name, frame, chunked=True,
+                                 batch_rows=BATCH_ROWS)
+        else:
+            chunked.create_table(name, frame)
+    load_sec = time.perf_counter() - t0
+    n_batches = -(-li_rows // BATCH_ROWS)
+
+    results = {}
+
+    def _write(done=False):
+        artifact = {
+            "metric": "streaming_mesh_scale",
+            "sf": SF,
+            "mesh_devices": mesh_devices,
+            "lineitem_rows": li_rows,
+            "lineitem_host_bytes": li_bytes,
+            "batch_rows": BATCH_ROWS,
+            "n_batches": n_batches,
+            "batch_device_bytes_approx": int(li_bytes / max(n_batches, 1)),
+            "gen_sec": round(gen_sec, 1),
+            "load_sec": round(load_sec, 1),
+            "oracle": "benchmarks/pandas_tpch.py (independent host impl; "
+                      "itself oracle-tested against the engine in "
+                      "tests/integration/test_pandas_oracle.py)",
+            "queries": {str(k): v for k, v in results.items()},
+            "complete": done,
+            "all_equal": bool(results) and all(r.get("equal")
+                                               for r in results.values()),
+            # whole-process RSS (generator + pandas oracle included): ops
+            # visibility only — the out-of-core claim is the device working
+            # set, batch_device_bytes_approx vs lineitem_host_bytes
+            "process_peak_rss_gb": round(_rss_gb(), 2),
+        }
+        # in-flight progress goes to a sidecar; OUT itself is only ever
+        # replaced by a complete run, so an interrupted rerun can't
+        # overwrite a previous certification with a partial result
+        path = OUT if done else OUT + ".partial"
+        with open(path, "w") as f:
+            json.dump(artifact, f, indent=1)
+        return artifact
+
+    for qid in QIDS:
+        rec = {}
+        try:
+            # pandas is the equality oracle: an 8-thread GSPMD program on a
+            # 1-core host spends minutes in collective rendezvous, so the
+            # resident engine as oracle would measure the simulator, not us
+            t0 = time.perf_counter()
+            want = PANDAS_QUERIES[qid](data)
+            rec["pandas_sec"] = round(time.perf_counter() - t0, 2)
+            t0 = time.perf_counter()
+            got = chunked.sql(QUERIES[qid], return_futures=False)
+            rec["chunked_sec"] = round(time.perf_counter() - t0, 2)
+            got.columns = [c.lower() for c in got.columns]
+            want.columns = [c.lower() for c in want.columns]
+            for col in got.columns:
+                if got[col].dtype.kind == "M":
+                    got[col] = got[col].dt.strftime("%Y-%m-%d")
+                if col in want.columns and want[col].dtype.kind == "M":
+                    want[col] = want[col].dt.strftime("%Y-%m-%d")
+            srt = list(want.columns)
+            rec["equal"] = _frames_equal(
+                want.sort_values(srt, ignore_index=True),
+                got[srt].sort_values(srt, ignore_index=True))
+            rec["rows_out"] = len(got)
+        except Exception as e:  # record, keep going
+            rec["error"] = f"{type(e).__name__}: {e}"[:300]
+        rec["process_rss_gb"] = round(_rss_gb(), 2)
+        results[qid] = rec
+        _write()
+        print(f"Q{qid}: {rec}", flush=True)
+
+    artifact = _write(done=True)
+    print(json.dumps({"metric": "streaming_mesh_scale",
+                      "value": artifact["all_equal"],
+                      "detail": OUT}))
+
+
+if __name__ == "__main__":
+    main()
