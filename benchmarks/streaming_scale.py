@@ -133,6 +133,11 @@ def main():
         path = OUT if done else OUT + ".partial"
         with open(path, "w") as f:
             json.dump(artifact, f, indent=1)
+        if done:
+            try:
+                os.remove(OUT + ".partial")
+            except OSError:
+                pass
         return artifact
 
     for qid in QIDS:

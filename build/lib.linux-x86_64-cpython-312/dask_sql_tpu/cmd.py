@@ -1,0 +1,84 @@
+"""Interactive SQL REPL (reference: /root/reference/dask_sql/cmd.py:21-156).
+
+``dask-sql-tpu`` console entry point: prompt_toolkit session with SQL pygments
+highlighting; ``--load-test-data`` registers a synthetic timeseries table like
+the reference's ``dask.datasets.timeseries``.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+from typing import Optional
+
+
+def _make_test_data():
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.RandomState(42)
+    n = 30 * 24 * 60  # a month of minutes
+    return pd.DataFrame({
+        "timestamp": pd.date_range("2000-01-01", periods=n, freq="min"),
+        "id": rng.randint(800, 1200, n),
+        "name": rng.choice(list("ABCDEFGH"), n),
+        "x": rng.uniform(-1, 1, n),
+        "y": rng.uniform(-1, 1, n),
+    })
+
+
+def cmd_loop(context=None, client=None, startup: bool = False,
+             log_level=None):
+    """Run the REPL loop (reference cmd.py:48-110)."""
+    if log_level:
+        logging.basicConfig(level=log_level)
+    from .context import Context
+
+    context = context or Context()
+    if startup:
+        context.sql("SELECT 1 + 1")
+
+    try:
+        from prompt_toolkit import PromptSession
+        from prompt_toolkit.lexers import PygmentsLexer
+        from pygments.lexers.sql import SqlLexer
+        session = PromptSession(lexer=PygmentsLexer(SqlLexer))
+        prompt = lambda: session.prompt("(dask-sql-tpu) > ")  # noqa: E731
+    except ImportError:
+        prompt = lambda: input("(dask-sql-tpu) > ")  # noqa: E731
+
+    while True:
+        try:
+            text = prompt()
+        except (EOFError, KeyboardInterrupt):
+            break
+        text = text.rstrip(";").strip()
+        if not text:
+            continue
+        if text.lower() in ("quit", "exit"):
+            break
+        try:
+            result = context.sql(text)
+            if result is not None and result.num_columns:
+                print(result.to_pandas())
+        except Exception as e:  # pragma: no cover - interactive
+            print(f"{type(e).__name__}: {e}")
+
+
+def main():  # pragma: no cover - console entry
+    parser = argparse.ArgumentParser(description="dask-sql-tpu REPL")
+    parser.add_argument("--load-test-data", action="store_true",
+                        help="Register a synthetic timeseries table 'timeseries'")
+    parser.add_argument("--startup", action="store_true",
+                        help="Run a first query at startup to warm compilation")
+    parser.add_argument("--log-level", default=None)
+    args = parser.parse_args()
+
+    from .context import Context
+    context = Context()
+    if args.load_test_data:
+        context.create_table("timeseries", _make_test_data())
+    cmd_loop(context=context, startup=args.startup, log_level=args.log_level)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

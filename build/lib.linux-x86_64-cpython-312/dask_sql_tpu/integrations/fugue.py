@@ -1,0 +1,91 @@
+"""Fugue integration: this engine as a fugue SQLEngine.
+
+Mirror of the reference's integration surface
+(/root/reference/dask_sql/integrations/fugue.py:19-132): a ``SQLEngine``
+whose ``select`` routes fugue dataframes through a fresh ``Context``, an
+``ExecutionEngine`` that installs it as the default SQL engine, and an
+``fsql_tpu`` workflow helper that registers results back into a Context.
+Fugue is an optional dependency (reference setup.py:99); everything here is
+import-gated so the module loads (and the rest of the package works) without
+it.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..context import Context
+
+try:
+    import fugue
+    import fugue.execution.execution_engine as _fee
+    from fugue.workflow.workflow import FugueSQLWorkflow, WorkflowDataFrame
+
+    _HAS_FUGUE = True
+except ImportError:  # pragma: no cover - fugue not in this image
+    fugue = None
+    _HAS_FUGUE = False
+
+
+def _require_fugue():
+    if not _HAS_FUGUE:
+        raise ImportError(
+            "The fugue integration requires the 'fugue' package "
+            "(pip install fugue)")
+
+
+if _HAS_FUGUE:  # pragma: no cover - mirrors reference fugue.py:23-67
+
+    class TpuSQLEngine(_fee.SQLEngine):
+        """Fugue SQL engine backed by this TPU engine (reference
+        DaskSQLEngine, fugue.py:23-47)."""
+
+        def select(self, dfs, statement: str):
+            c = Context()
+            for k, v in dfs.items():
+                c.create_table(k, self.execution_engine.to_df(v).as_pandas())
+            df = c.sql(statement, return_futures=False)
+            return self.execution_engine.to_df(df)
+
+    class TpuSQLExecutionEngine(fugue.NativeExecutionEngine):
+        """Execution engine with TpuSQLEngine as default SQL engine
+        (reference DaskSQLExecutionEngine, fugue.py:50-67)."""
+
+        def __init__(self, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._default_sql_engine = TpuSQLEngine(self)
+
+        @property
+        def default_sql_engine(self):
+            return self._default_sql_engine
+
+else:  # placeholders that explain themselves
+
+    class TpuSQLEngine:  # type: ignore[no-redef]
+        def __init__(self, *args, **kwargs):
+            _require_fugue()
+
+    class TpuSQLExecutionEngine:  # type: ignore[no-redef]
+        def __init__(self, *args, **kwargs):
+            _require_fugue()
+
+
+def fsql_tpu(sql: str, ctx: Optional[Context] = None, register: bool = False,
+             fugue_conf: Any = None) -> Dict[str, Any]:
+    """Run a fugue-SQL workflow against this engine's tables (reference
+    fsql_dask, fugue.py:70-132). Named steps come back as pandas frames;
+    ``register=True`` re-registers them on ``ctx``."""
+    _require_fugue()
+    dag = FugueSQLWorkflow()
+    dfs = ({} if ctx is None else
+           {k: dag.df(entry.table.to_pandas())
+            for k, entry in ctx.schema[ctx.schema_name].tables.items()
+            if entry.table is not None})
+    result = dag._sql(sql, **dfs)
+    dag.run(TpuSQLExecutionEngine(conf=fugue_conf))
+
+    result_dfs = {k: v.result.native for k, v in result.items()
+                  if isinstance(v, WorkflowDataFrame)}
+    if register and ctx is not None:
+        for k, v in result_dfs.items():
+            ctx.create_table(k, v)
+    return result_dfs

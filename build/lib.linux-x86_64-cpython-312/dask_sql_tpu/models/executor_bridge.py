@@ -1,0 +1,13 @@
+"""Small bridge: run a bound query AST through plan+execute (used by ML
+statements, which hold the inner SELECT as AST instead of re-stringifying it
+the way the reference must, create_model.py:157-158)."""
+from __future__ import annotations
+
+from ..table import Table
+
+
+def run_query(context, query_ast, sql: str) -> Table:
+    from ..physical.rel.executor import RelExecutor
+
+    plan = context._get_plan(query_ast, sql)
+    return RelExecutor(context).execute(plan)

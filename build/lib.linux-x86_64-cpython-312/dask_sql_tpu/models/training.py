@@ -1,0 +1,166 @@
+"""SQL-driven ML: CREATE MODEL / CREATE EXPERIMENT / EXPORT MODEL.
+
+Re-implements the reference's ML statements
+(/root/reference/dask_sql/physical/rel/custom/create_model.py:11-171,
+create_experiment.py:14-224, export_model.py:10-89): train an sklearn-style
+estimator on the result of a SELECT, run hyperparameter search, serialize
+models.  Training data is gathered from device to host numpy — model fitting
+is a host-side affair in the reference too (dask-ml collects partitions).
+"""
+from __future__ import annotations
+
+import importlib
+import logging
+import pickle
+from typing import Any, Optional
+
+import numpy as np
+
+from ..sql import ast as A
+from ..table import Table
+
+logger = logging.getLogger(__name__)
+
+
+def import_class(name: str) -> type:
+    """Dynamic import of 'package.module.Class' (reference utils.py:238-245)."""
+    module_path, _, class_name = name.rpartition(".")
+    module = importlib.import_module(module_path)
+    return getattr(module, class_name)
+
+
+def _gather_xy(table: Table, target_column: Optional[str]):
+    df = table.to_pandas()
+    if target_column:
+        y = df[target_column].to_numpy()
+        X = df.drop(columns=[target_column])
+    else:
+        y = None
+        X = df
+    return X, y
+
+
+def create_model(stmt: A.CreateModel, context, sql: str):
+    schema_name, name = context.fqn(stmt.name)
+    if name in context.schema[schema_name].models:
+        if stmt.if_not_exists:
+            return None
+        if not stmt.or_replace:
+            raise RuntimeError(f"A model with the name {name} is already present.")
+
+    kwargs = dict(stmt.kwargs)
+    try:
+        model_class = kwargs.pop("model_class")
+    except KeyError:
+        raise AttributeError("Parameters must include a 'model_class' parameter.")
+    target_column = kwargs.pop("target_column", "")
+    wrap_predict = bool(kwargs.pop("wrap_predict", False))
+    wrap_fit = bool(kwargs.pop("wrap_fit", False))
+    fit_kwargs = kwargs.pop("fit_kwargs", {})
+
+    ModelClass = import_class(model_class)
+    model = ModelClass(**kwargs)
+    # dask-ml Incremental/ParallelPostFit wrappers (reference
+    # create_model.py:141-155) are meaningless on a single device table; the
+    # flags are accepted for API parity and ignored.
+    del wrap_predict, wrap_fit
+
+    from .executor_bridge import run_query
+    training_table = run_query(context, stmt.query, sql)
+    X, y = _gather_xy(training_table, target_column)
+    if y is not None:
+        model.fit(X.to_numpy(dtype=np.float64, na_value=np.nan)
+                  if _all_numeric(X) else X, y, **fit_kwargs)
+    else:
+        model.fit(X.to_numpy(dtype=np.float64, na_value=np.nan)
+                  if _all_numeric(X) else X, **fit_kwargs)
+    context.register_model(name, model, X.columns.tolist(), schema_name=schema_name)
+    return None
+
+
+def _all_numeric(df) -> bool:
+    return all(k.kind in "ifb" for k in df.dtypes)
+
+
+def create_experiment(stmt: A.CreateExperiment, context, sql: str):
+    schema_name, name = context.fqn(stmt.name)
+    if name in context.schema[schema_name].models and not (stmt.if_not_exists or stmt.or_replace):
+        raise RuntimeError(f"An experiment with the name {name} is already present.")
+    if name in context.schema[schema_name].models and stmt.if_not_exists:
+        return None
+
+    kwargs = dict(stmt.kwargs)
+    model_class = kwargs.pop("model_class", None)
+    experiment_class = kwargs.pop("experiment_class", None)
+    automl_class = kwargs.pop("automl_class", None)
+    target_column = kwargs.pop("target_column", "")
+    tune_params = kwargs.pop("tune_parameters", {})
+    experiment_kwargs = kwargs.pop("experiment_kwargs", {})
+    automl_kwargs = kwargs.pop("automl_kwargs", {})
+
+    from .executor_bridge import run_query
+    training_table = run_query(context, stmt.query, sql)
+    X, y = _gather_xy(training_table, target_column)
+    Xn = X.to_numpy(dtype=np.float64, na_value=np.nan) if _all_numeric(X) else X
+
+    if automl_class:
+        AutoML = import_class(automl_class)
+        automl = AutoML(**automl_kwargs)
+        automl.fit(Xn, y)
+        best = getattr(automl, "fitted_pipeline_", automl)
+        context.register_model(name, best, X.columns.tolist(), schema_name=schema_name)
+        return None
+
+    if not model_class:
+        raise AttributeError("Parameters must include a 'model_class' or 'automl_class'.")
+    if not experiment_class:
+        raise AttributeError(
+            f"Parameters must include a 'experiment_class' parameter for tuning {model_class}.")
+    ModelClass = import_class(model_class)
+    ExperimentClass = import_class(experiment_class)
+    model = ModelClass(**kwargs)
+    search = ExperimentClass(model, dict(tune_params), **experiment_kwargs)
+    search.fit(Xn, y)
+
+    import pandas as pd
+    results = pd.DataFrame(search.cv_results_)
+    # stringify param objects for device storage
+    for c in results.columns:
+        if results[c].dtype == object:
+            results[c] = results[c].map(str)
+    experiment_table = Table.from_pandas(results)
+    context.schema[schema_name].experiments[name] = experiment_table
+    context.register_model(name, search.best_estimator_, X.columns.tolist(),
+                           schema_name=schema_name)
+    return experiment_table
+
+
+def export_model(stmt: A.ExportModel, context, sql: str):
+    info = context.resolve_model(stmt.name)
+    if info is None:
+        raise RuntimeError(f"A model with the name {'.'.join(stmt.name)} is not present.")
+    model, training_columns = info
+    kwargs = dict(stmt.kwargs)
+    fmt = str(kwargs.pop("format", "pickle")).lower()
+    try:
+        location = kwargs.pop("location")
+    except KeyError:
+        raise AttributeError("Parameters must include a 'location' parameter.")
+
+    if fmt in ("pickle", "pkl"):
+        with open(location, "wb") as f:
+            pickle.dump(model, f, **kwargs)
+    elif fmt == "joblib":
+        import joblib
+        joblib.dump(model, location, **kwargs)
+    elif fmt == "mlflow":
+        try:
+            import mlflow
+        except ImportError:
+            raise NotImplementedError("mlflow is not installed in this environment")
+        mlflow.sklearn.save_model(model, location, **kwargs)
+    elif fmt == "onnx":
+        raise NotImplementedError("ONNX export is not implemented (parity with reference)")
+    else:
+        raise NotImplementedError(f"Unknown format {fmt}")
+    return None

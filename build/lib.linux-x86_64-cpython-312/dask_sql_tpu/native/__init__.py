@@ -1,0 +1,87 @@
+"""Loader for the native (C++) planner front-end.
+
+The reference's planner is native too (Java/Calcite compiled to DaskSQL.jar
+and loaded in-process, /root/reference/dask_sql/java.py:62-98, setup.py:25-42).
+Here the native piece is a C++ recursive-descent parser built into
+``libdsqlparser.so`` (sources in ``native/`` at the repo root) and loaded via
+ctypes.  If the prebuilt library is missing we try one lazy ``make``; on any
+failure the pure-Python parser in ``dask_sql_tpu.sql.parser`` serves as the
+fallback, keeping the package importable without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import logging
+import os
+import subprocess
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_LIB_NAME = "libdsqlparser.so"
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _try_build() -> bool:
+    """One best-effort build of the native library (repo checkouts only)."""
+    native_src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "native")
+    if not os.path.isfile(os.path.join(native_src, "Makefile")):
+        return False
+    try:
+        subprocess.run(["make", "-C", native_src], capture_output=True,
+                       timeout=120, check=True)
+        return True
+    except Exception as exc:  # toolchain missing, build error, timeout
+        logger.debug("native parser build failed: %s", exc)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if necessary) the native parser library, or None."""
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("DSQL_NATIVE", "1") == "0":
+        return None
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), _LIB_NAME)
+    if not os.path.isfile(path) and not _try_build():
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.dsql_parse.argtypes = [ctypes.c_char_p]
+        lib.dsql_parse.restype = ctypes.c_void_p  # keep pointer for dsql_free
+        lib.dsql_free.argtypes = [ctypes.c_void_p]
+        lib.dsql_free.restype = None
+        _lib = lib
+    except OSError as exc:
+        logger.debug("native parser load failed: %s", exc)
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    """True when the native parser library is loadable (CI gate)."""
+    return load() is not None
+
+
+def parse_to_json(sql: str) -> Optional[dict]:
+    """Parse via the native library; returns the decoded JSON envelope.
+
+    ``{"ok": [...statements]}`` on success, ``{"error": {...}}`` on parse
+    error, or None when the native library is unavailable.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    ptr = lib.dsql_parse(sql.encode("utf-8"))
+    if not ptr:
+        return None
+    try:
+        raw = ctypes.string_at(ptr)
+    finally:
+        lib.dsql_free(ptr)
+    return json.loads(raw.decode("utf-8"))

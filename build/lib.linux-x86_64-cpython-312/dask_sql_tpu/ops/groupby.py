@@ -1,0 +1,429 @@
+"""Segmented aggregation kernels: SQL GROUP BY on device.
+
+TPU-native replacement for the reference's groupby lowering
+(/root/reference/dask_sql/physical/rel/logical/aggregate.py:19-361 and the
+NULL-group trick in physical/utils/groupby.py:8-34): keys factorize to dense
+codes (NULLs form their own group), then every aggregate is a
+``jax.ops.segment_*`` reduction — no shuffle, no per-group python.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..table import dict_sort_order, Column, Scalar, Table
+from ..types import SqlType, exact_decimal_scale, physical_dtype
+from .kernels import decimal_unscale, factorize_columns
+
+
+def group_codes(key_cols: List[Column]):
+    """Factorize group keys. Returns (codes, first_row_per_group, G)."""
+    if not key_cols:
+        return None, None, 1
+    return factorize_columns(key_cols, null_as_group=True)
+
+
+def _masked(col: Column, extra_mask: Optional[jax.Array]):
+    data = col.data
+    valid = col.valid_mask()
+    if extra_mask is not None:
+        valid = valid & extra_mask
+    return data, valid
+
+
+def _decimal_exact_result(op: str, s_int, count, dscale: int,
+                          out_type: SqlType) -> Column:
+    """Shared tail of the exact scaled-int64 SUM/$SUM0/AVG paths: unscale
+    via the exact-quotient route and apply the SQL NULL rules (SUM over no
+    rows -> NULL, $SUM0 -> 0, AVG -> NULL)."""
+    has_any = count > 0
+    if op in ("SUM", "$SUM0"):
+        s = decimal_unscale(s_int, dscale).astype(physical_dtype(out_type))
+        return Column(s, out_type, None if op == "$SUM0" else has_any)
+    mean = s_int.astype(jnp.float64) / (jnp.maximum(count, 1) * 10.0 ** dscale)
+    return Column(mean, out_type, has_any)
+
+
+def _decimal_scaled_ints(data, dscale: int):
+    """Round f64 decimal data onto its integer grid (int64 'cents')."""
+    return jnp.round(data.astype(jnp.float64) * 10.0 ** dscale
+                     ).astype(jnp.int64)
+
+
+def segment_aggregate(op: str, col: Optional[Column], codes: Optional[jax.Array],
+                      num_groups: int, out_type: SqlType,
+                      filter_mask: Optional[jax.Array] = None,
+                      n_rows: int = 0) -> Column:
+    """One aggregate over segments. ``codes=None`` means whole-table (1 group)."""
+    if codes is None:
+        codes = jnp.zeros(n_rows if col is None else len(col), dtype=jnp.int64)
+        num_groups = 1
+
+    if op in ("COUNT", "REGR_COUNT"):
+        if col is None:
+            ones = jnp.ones(codes.shape[0], dtype=jnp.int64)
+            if filter_mask is not None:
+                ones = jnp.where(filter_mask, ones, 0)
+            out = jax.ops.segment_sum(ones, codes, num_groups)
+        else:
+            data, valid = _masked(col, filter_mask)
+            out = jax.ops.segment_sum(valid.astype(jnp.int64), codes, num_groups)
+        return Column(out, out_type, None)
+
+    assert col is not None, f"{op} requires an argument"
+    data, valid = _masked(col, filter_mask)
+    count = jax.ops.segment_sum(valid.astype(jnp.int64), codes, num_groups)
+    has_any = count > 0
+
+    if op in ("SUM", "$SUM0", "AVG", "STDDEV", "STDDEV_POP", "STDDEV_SAMP",
+              "VAR_POP", "VAR_SAMP", "VARIANCE"):
+        dscale = exact_decimal_scale(col.stype) if op in ("SUM", "$SUM0",
+                                                          "AVG") else None
+        if dscale is not None:
+            # exact scaled-int64 money math: order-independent, bit-stable
+            iwork = jnp.where(valid, _decimal_scaled_ints(data, dscale), 0)
+            s_int = jax.ops.segment_sum(iwork, codes, num_groups)
+            return _decimal_exact_result(op, s_int, count, dscale, out_type)
+        work = data.astype(jnp.float64) if not jnp.issubdtype(data.dtype, jnp.integer) else data.astype(jnp.int64)
+        work = jnp.where(valid, work, 0)
+        s = jax.ops.segment_sum(work, codes, num_groups)
+        if op == "SUM":
+            return Column(s.astype(physical_dtype(out_type)), out_type,
+                          has_any)
+        if op == "$SUM0":
+            return Column(s.astype(physical_dtype(out_type)), out_type, None)
+        mean = s.astype(jnp.float64) / jnp.maximum(count, 1)
+        if op == "AVG":
+            return Column(mean, out_type, has_any)
+        sq = jnp.where(valid, data.astype(jnp.float64) ** 2, 0.0)
+        s2 = jax.ops.segment_sum(sq, codes, num_groups)
+        var_pop = s2 / jnp.maximum(count, 1) - mean**2
+        var_pop = jnp.maximum(var_pop, 0.0)
+        if op == "VAR_POP":
+            return Column(var_pop, out_type, has_any)
+        denom = jnp.maximum(count - 1, 1)
+        var_samp = (s2 - count * mean**2) / denom
+        var_samp = jnp.maximum(var_samp, 0.0)
+        ok = count > 1
+        if op in ("VAR_SAMP", "VARIANCE"):
+            return Column(var_samp, out_type, ok)
+        if op == "STDDEV_POP":
+            return Column(jnp.sqrt(var_pop), out_type,
+                          has_any)
+        return Column(jnp.sqrt(var_samp), out_type, ok)
+
+    if op in ("MIN", "MAX"):
+        if col.stype.is_string:
+            ranked = col.dict_ranks()
+            rdata = ranked.data.astype(jnp.int64)
+            sentinel = jnp.iinfo(jnp.int64).max if op == "MIN" else jnp.iinfo(jnp.int64).min
+            work = jnp.where(valid, rdata, sentinel)
+            f = jax.ops.segment_min if op == "MIN" else jax.ops.segment_max
+            out_ranks = f(work, codes, num_groups)
+            # map ranks back to dictionary codes
+            order = dict_sort_order(col.dictionary)
+            inv = jnp.asarray(order.astype(np.int64))
+            safe = jnp.clip(out_ranks, 0, len(order) - 1)
+            out_codes = jnp.take(inv, safe).astype(jnp.int32)
+            return Column(out_codes, out_type,
+                          has_any, col.dictionary)
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            sentinel = jnp.inf if op == "MIN" else -jnp.inf
+        elif data.dtype == jnp.bool_:
+            data = data.astype(jnp.int64)
+            sentinel = 1 if op == "MIN" else 0
+        else:
+            info = jnp.iinfo(data.dtype)
+            sentinel = info.max if op == "MIN" else info.min
+        work = jnp.where(valid, data, sentinel)
+        f = jax.ops.segment_min if op == "MIN" else jax.ops.segment_max
+        out = f(work, codes, num_groups)
+        out = out.astype(physical_dtype(out_type))
+        return Column(out, out_type, has_any)
+
+    if op in ("EVERY", "BOOL_AND"):
+        work = jnp.where(valid, data.astype(bool), True)
+        out = jax.ops.segment_min(work.astype(jnp.int32), codes, num_groups) > 0
+        return Column(out, out_type, has_any)
+    if op in ("BOOL_OR", "ANY"):
+        work = jnp.where(valid, data.astype(bool), False)
+        out = jax.ops.segment_max(work.astype(jnp.int32), codes, num_groups) > 0
+        return Column(out, out_type, has_any)
+
+    if op in ("ANY_VALUE", "SINGLE_VALUE", "FIRST_VALUE", "LAST_VALUE"):
+        n = codes.shape[0]
+        idx = jnp.arange(n)
+        if op == "LAST_VALUE":
+            work = jnp.where(valid, idx, -1)
+            pick = jax.ops.segment_max(work, codes, num_groups)
+        else:
+            work = jnp.where(valid, idx, n)
+            pick = jax.ops.segment_min(work, codes, num_groups)
+        safe = jnp.clip(pick, 0, max(n - 1, 0))
+        out = col.take(safe)
+        return out.with_mask(out.valid_mask() & has_any)
+
+    if op in ("BIT_AND", "BIT_OR", "BIT_XOR"):
+        # no XLA segment primitive for bit ops: host reduceat over sorted codes
+        np_codes = np.asarray(codes)
+        np_data = np.asarray(data)
+        np_valid = np.asarray(valid)
+        order = np.argsort(np_codes, kind="stable")
+        sc, sd, sv = np_codes[order], np_data[order], np_valid[order]
+        ident = {"BIT_AND": -1, "BIT_OR": 0, "BIT_XOR": 0}[op]
+        sd = np.where(sv, sd, ident)
+        ufn = {"BIT_AND": np.bitwise_and, "BIT_OR": np.bitwise_or,
+               "BIT_XOR": np.bitwise_xor}[op]
+        starts = np.searchsorted(sc, np.arange(num_groups))
+        out = np.full(num_groups, ident, dtype=np_data.dtype)
+        present = np.zeros(num_groups, bool)
+        if len(sd):
+            seg = ufn.reduceat(sd, np.minimum(starts, len(sd) - 1))
+            counts = np.diff(np.append(starts, len(sd)))
+            present = counts > 0
+            out = np.where(present, seg, ident)
+        has = np.asarray(has_any)
+        return Column(jnp.asarray(out).astype(physical_dtype(out_type)), out_type,
+                      None if has.all() else jnp.asarray(has))
+
+    if op == "LISTAGG":
+        np_codes = np.asarray(codes)
+        vals = col.decode() if col.stype.is_string else col.to_numpy().astype(object)
+        np_valid = np.asarray(valid)
+        outs = [[] for _ in range(num_groups)]
+        for c, v, ok in zip(np_codes, vals, np_valid):
+            if ok:
+                outs[int(c)].append(str(v))
+        strs = np.array([",".join(o) if o else None for o in outs], dtype=object)
+        return Column._encode_strings(strs, None)
+
+    raise NotImplementedError(f"Aggregate {op}")
+
+
+def distinct_rows(cols: List[Column]) -> jax.Array:
+    """Row indices of first occurrences of each distinct key combination."""
+    codes, first, G = factorize_columns(cols, null_as_group=True)
+    return jnp.sort(first)
+
+
+def dedup_for_distinct_agg(group_codes_arr: jax.Array, value_col: Column,
+                           filter_mask: Optional[jax.Array]):
+    """Keep one row per (group, value) pair for DISTINCT aggregates.
+
+    Returns (row_indices, new_codes) to aggregate over.
+    """
+    vals_codes, _, _ = factorize_columns([value_col], null_as_group=True)
+    m = int(vals_codes.max()) + 1 if vals_codes.shape[0] else 1
+    pair = group_codes_arr * m + vals_codes
+    keep = value_col.valid_mask()
+    if filter_mask is not None:
+        keep = keep & filter_mask
+    # make invalid rows unique-but-droppable: set pair=-1-row to dedupe safely
+    n = pair.shape[0]
+    pair = jnp.where(keep, pair, -1 - jnp.arange(n, dtype=pair.dtype))
+    uniq, first_idx = np.unique(np.asarray(pair), return_index=True)
+    rows = jnp.asarray(np.sort(first_idx[uniq >= 0]))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# scatter-free aggregation over group-sorted rows (TPU hot path, used by the
+# compiled executor — physical/compiled.py). See ops/sorted_agg.py for the
+# primitive layer and the rationale (TPU scatter is serialized).
+# ---------------------------------------------------------------------------
+
+def sorted_segment_aggregate(op: str, col_sorted: Optional[Column],
+                             valid_sorted: Optional[jax.Array],
+                             codes_sorted: jax.Array, starts: jax.Array,
+                             ends: jax.Array, out_type: SqlType) -> Column:
+    """One aggregate over a group-sorted stream, gathers/scans only.
+
+    ``col_sorted`` is the argument column already permuted into group order
+    (None for COUNT(*)); ``valid_sorted`` is the combined row-validity +
+    FILTER-clause + value-nullability mask in the same order.
+    """
+    from . import sorted_agg as sa
+
+    n = codes_sorted.shape[0]
+    if valid_sorted is None:
+        valid_sorted = jnp.ones(n, dtype=bool)
+
+    if op in ("COUNT", "REGR_COUNT"):
+        return Column(sa.seg_count(valid_sorted, starts, ends), out_type, None)
+
+    assert col_sorted is not None, f"{op} requires an argument"
+    data = col_sorted.data
+    count = sa.seg_count(valid_sorted, starts, ends)
+    has_any = count > 0
+
+    if op in ("SUM", "$SUM0", "AVG", "STDDEV", "STDDEV_POP", "STDDEV_SAMP",
+              "VAR_POP", "VAR_SAMP", "VARIANCE"):
+        dscale = exact_decimal_scale(col_sorted.stype) if op in (
+            "SUM", "$SUM0", "AVG") else None
+        if dscale is not None:
+            idata = _decimal_scaled_ints(data, dscale)
+            s_int = sa.seg_sum(idata, valid_sorted, codes_sorted, starts,
+                               ends).astype(jnp.int64)
+            return _decimal_exact_result(op, s_int, count, dscale, out_type)
+        s = sa.seg_sum(data, valid_sorted, codes_sorted, starts, ends)
+        if op == "SUM":
+            return Column(s.astype(physical_dtype(out_type)), out_type, has_any)
+        if op == "$SUM0":
+            return Column(s.astype(physical_dtype(out_type)), out_type, None)
+        mean = s.astype(jnp.float64) / jnp.maximum(count, 1)
+        if op == "AVG":
+            return Column(mean, out_type, has_any)
+        sq = data.astype(jnp.float64) ** 2
+        s2 = sa.seg_sum(sq, valid_sorted, codes_sorted, starts, ends)
+        var_pop = jnp.maximum(s2 / jnp.maximum(count, 1) - mean**2, 0.0)
+        if op == "VAR_POP":
+            return Column(var_pop, out_type, has_any)
+        denom = jnp.maximum(count - 1, 1)
+        var_samp = jnp.maximum((s2 - count * mean**2) / denom, 0.0)
+        ok = count > 1
+        if op in ("VAR_SAMP", "VARIANCE"):
+            return Column(var_samp, out_type, ok)
+        if op == "STDDEV_POP":
+            return Column(jnp.sqrt(var_pop), out_type, has_any)
+        return Column(jnp.sqrt(var_samp), out_type, ok)
+
+    if op in ("MIN", "MAX"):
+        if col_sorted.stype.is_string:
+            ranked = col_sorted.dict_ranks().data.astype(jnp.int64)
+            f = sa.seg_min if op == "MIN" else sa.seg_max
+            out_ranks = f(ranked, valid_sorted, codes_sorted, ends)
+            order = dict_sort_order(col_sorted.dictionary)
+            inv = jnp.asarray(order.astype(np.int64))
+            safe = jnp.clip(out_ranks, 0, len(order) - 1)
+            return Column(jnp.take(inv, safe).astype(jnp.int32), out_type,
+                          has_any, col_sorted.dictionary)
+        f = sa.seg_min if op == "MIN" else sa.seg_max
+        out = f(data, valid_sorted, codes_sorted, ends)
+        return Column(out.astype(physical_dtype(out_type)), out_type, has_any)
+
+    if op in ("EVERY", "BOOL_AND"):
+        out = sa.seg_min(jnp.where(valid_sorted, data.astype(bool), True)
+                         .astype(jnp.int32),
+                         jnp.ones(n, bool), codes_sorted, ends) > 0
+        return Column(out, out_type, has_any)
+    if op in ("BOOL_OR", "ANY"):
+        out = sa.seg_max(jnp.where(valid_sorted, data.astype(bool), False)
+                         .astype(jnp.int32),
+                         jnp.ones(n, bool), codes_sorted, ends) > 0
+        return Column(out, out_type, has_any)
+
+    if op in ("ANY_VALUE", "SINGLE_VALUE", "FIRST_VALUE", "LAST_VALUE"):
+        if op == "LAST_VALUE":
+            pos = sa.seg_last_valid_pos(valid_sorted, codes_sorted, ends)
+        else:
+            pos = sa.seg_first_valid_pos(valid_sorted, codes_sorted, ends)
+        safe = jnp.clip(pos, 0, max(n - 1, 0))
+        out = col_sorted.take(safe)
+        return out.with_mask(out.valid_mask() & has_any)
+
+    raise NotImplementedError(f"Sorted aggregate {op}")
+
+
+def whole_table_aggregate(op: str, col: Optional[Column],
+                          fmask: Optional[jax.Array], out_type: SqlType,
+                          n_rows: int) -> Column:
+    """Ungrouped aggregate as direct vector reductions — no segment ops.
+
+    The eager path routes this through segment_sum with one segment, whose
+    scatter lowering is pathological on TPU; a masked jnp.sum/min/max is a
+    single fast reduction.
+    """
+    def _valid(c: Optional[Column]) -> jax.Array:
+        v = jnp.ones(n_rows, dtype=bool) if fmask is None else fmask
+        if c is not None and c.mask is not None:
+            v = v & c.mask
+        return v
+
+    if op in ("COUNT", "REGR_COUNT"):
+        v = _valid(col)
+        return Column(jnp.sum(v.astype(jnp.int64)).reshape(1), out_type, None)
+
+    assert col is not None, f"{op} requires an argument"
+    valid = _valid(col)
+    data = col.data
+    count = jnp.sum(valid.astype(jnp.int64))
+    has_any = (count > 0).reshape(1)
+
+    if op in ("SUM", "$SUM0", "AVG", "STDDEV", "STDDEV_POP", "STDDEV_SAMP",
+              "VAR_POP", "VAR_SAMP", "VARIANCE"):
+        dscale = exact_decimal_scale(col.stype) if op in ("SUM", "$SUM0",
+                                                          "AVG") else None
+        if dscale is not None:
+            iwork = jnp.where(valid, _decimal_scaled_ints(data, dscale), 0)
+            s_int = jnp.sum(iwork).reshape(1)
+            return _decimal_exact_result(op, s_int, count, dscale, out_type)
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            work = jnp.where(valid, data.astype(jnp.float64), 0.0)
+        else:
+            work = jnp.where(valid, data.astype(jnp.int64), 0)
+        s = jnp.sum(work).reshape(1)
+        if op == "SUM":
+            return Column(s.astype(physical_dtype(out_type)), out_type, has_any)
+        if op == "$SUM0":
+            return Column(s.astype(physical_dtype(out_type)), out_type, None)
+        mean = s.astype(jnp.float64) / jnp.maximum(count, 1)
+        if op == "AVG":
+            return Column(mean, out_type, has_any)
+        s2 = jnp.sum(jnp.where(valid, data.astype(jnp.float64) ** 2, 0.0)
+                     ).reshape(1)
+        var_pop = jnp.maximum(s2 / jnp.maximum(count, 1) - mean**2, 0.0)
+        if op == "VAR_POP":
+            return Column(var_pop, out_type, has_any)
+        denom = jnp.maximum(count - 1, 1)
+        var_samp = jnp.maximum((s2 - count * mean**2) / denom, 0.0)
+        ok = (count > 1).reshape(1)
+        if op in ("VAR_SAMP", "VARIANCE"):
+            return Column(var_samp, out_type, ok)
+        if op == "STDDEV_POP":
+            return Column(jnp.sqrt(var_pop), out_type, has_any)
+        return Column(jnp.sqrt(var_samp), out_type, ok)
+
+    if op in ("MIN", "MAX"):
+        if col.stype.is_string:
+            ranked = col.dict_ranks().data.astype(jnp.int64)
+            sent = jnp.iinfo(jnp.int64).max if op == "MIN" \
+                else jnp.iinfo(jnp.int64).min
+            work = jnp.where(valid, ranked, sent)
+            r = (jnp.min(work) if op == "MIN" else jnp.max(work)).reshape(1)
+            order = dict_sort_order(col.dictionary)
+            inv = jnp.asarray(order.astype(np.int64))
+            safe = jnp.clip(r, 0, len(order) - 1)
+            return Column(jnp.take(inv, safe).astype(jnp.int32), out_type,
+                          has_any, col.dictionary)
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            sent = jnp.inf if op == "MIN" else -jnp.inf
+        elif data.dtype == jnp.bool_:
+            data = data.astype(jnp.int64)
+            sent = 1 if op == "MIN" else 0
+        else:
+            info = jnp.iinfo(data.dtype)
+            sent = info.max if op == "MIN" else info.min
+        work = jnp.where(valid, data, sent)
+        out = (jnp.min(work) if op == "MIN" else jnp.max(work)).reshape(1)
+        return Column(out.astype(physical_dtype(out_type)), out_type, has_any)
+
+    if op in ("EVERY", "BOOL_AND"):
+        out = jnp.all(jnp.where(valid, data.astype(bool), True)).reshape(1)
+        return Column(out, out_type, has_any)
+    if op in ("BOOL_OR", "ANY"):
+        out = jnp.any(jnp.where(valid, data.astype(bool), False)).reshape(1)
+        return Column(out, out_type, has_any)
+
+    if op in ("ANY_VALUE", "SINGLE_VALUE", "FIRST_VALUE", "LAST_VALUE"):
+        idx = jnp.arange(n_rows, dtype=jnp.int64)
+        if op == "LAST_VALUE":
+            pos = jnp.max(jnp.where(valid, idx, -1)).reshape(1)
+        else:
+            pos = jnp.min(jnp.where(valid, idx, n_rows)).reshape(1)
+        out = col.take(jnp.clip(pos, 0, max(n_rows - 1, 0)))
+        return out.with_mask(out.valid_mask() & has_any)
+
+    raise NotImplementedError(f"Whole-table aggregate {op}")

@@ -1,0 +1,209 @@
+"""Equi-join kernels: sort-probe pair expansion on device.
+
+TPU-native replacement for the reference's join lowering
+(/root/reference/dask_sql/physical/rel/logical/join.py:20-313): the reference
+splits the condition into equi pairs + residual filter (join.py:245-284),
+delegates equi joins to dask's shuffle merge, hand-builds a partition-pair
+cross-join graph for non-equi (join.py:111-152), filters NULL keys
+(join.py:224-235) and patches lost outer rows (join.py:174-194).
+
+Here: keys factorize onto a shared domain (kernels.join_key_codes), the build
+side is sorted by code, probes binary-search their run, and matched pairs are
+materialized with a cumsum expansion — all jnp ops; sizes sync to host once
+per join (eager stage execution).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..table import Column, Table
+from .kernels import join_key_codes, mask_to_indices
+
+
+def _expand_matches(lcodes: jax.Array, rcodes: jax.Array
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Compute matching (left_row, right_row) index pairs for equi keys.
+
+    Returns (left_idx, right_idx, left_match_count).  Code -1 never matches.
+    """
+    order = jnp.argsort(rcodes, stable=True)
+    sorted_r = rcodes[order]
+    start = jnp.searchsorted(sorted_r, lcodes, side="left")
+    stop = jnp.searchsorted(sorted_r, lcodes, side="right")
+    counts = jnp.where(lcodes >= 0, stop - start, 0)
+    total = int(counts.sum())
+    offsets = jnp.cumsum(counts)
+    idx = jnp.arange(total)
+    li = jnp.searchsorted(offsets, idx, side="right")
+    prev = jnp.where(li > 0, offsets[jnp.maximum(li - 1, 0)], 0)
+    within = idx - prev
+    rpos = start[li] + within
+    ri = order[rpos]
+    return li, ri, counts
+
+
+def join_tables(left: Table, right: Table, left_keys: List[int],
+                right_keys: List[int], join_type: str,
+                null_aware_anti: bool = False,
+                null_equal: bool = False) -> Tuple[Table, Optional[jax.Array]]:
+    """Equi-join two tables.
+
+    Returns (joined_table, matched_pair_row_origin) where the joined table has
+    left columns then right columns.  For SEMI/ANTI only left columns.
+    Outer-join unmatched rows are appended after the matched pairs with NULLs
+    on the other side.
+    """
+    nl, nr = left.num_rows, right.num_rows
+    if left_keys:
+        lcodes, rcodes = join_key_codes(
+            [left.columns[i] for i in left_keys],
+            [right.columns[i] for i in right_keys],
+            null_equal=null_equal,
+        )
+    else:
+        # cross join: all pairs
+        lcodes = jnp.zeros(nl, dtype=jnp.int64)
+        rcodes = jnp.zeros(nr, dtype=jnp.int64)
+
+    if join_type == "SEMI":
+        li, ri, counts = _expand_matches(lcodes, rcodes)
+        keep = mask_to_indices(counts > 0)
+        return left.take(keep), None
+    if join_type == "ANTI":
+        li, ri, counts = _expand_matches(lcodes, rcodes)
+        if null_aware_anti:
+            # NOT IN semantics: if the build side contains any NULL key,
+            # nothing qualifies; rows with NULL probe keys qualify only
+            # when the build side is EMPTY (x NOT IN (empty) is TRUE for
+            # every x, NULL included — PostgreSQL/SQLite agree).
+            build_has_null = bool((rcodes < 0).any()) if nr else False
+            if build_has_null:
+                return left.take(jnp.zeros(0, dtype=jnp.int64)), None
+            keep = mask_to_indices((counts == 0)
+                                   & ((lcodes >= 0) | (nr == 0)))
+        else:
+            keep = mask_to_indices(counts == 0)
+        return left.take(keep), None
+
+    li, ri, counts = _expand_matches(lcodes, rcodes)
+    return _assemble(left, right, li, ri, counts, rcodes, join_type)
+
+
+def _assemble(left: Table, right: Table, li, ri, counts, rcodes,
+              join_type: str) -> Tuple[Table, Optional[jax.Array]]:
+    nl, nr = left.num_rows, right.num_rows
+    n_pairs = int(li.shape[0])
+
+    lt = left.take(li)
+    rt = right.take(ri)
+
+    extra_left = extra_right = None
+    if join_type in ("LEFT", "FULL"):
+        extra_left = mask_to_indices(counts == 0)
+    if join_type in ("RIGHT", "FULL"):
+        matched_r = jnp.zeros(nr, dtype=bool)
+        if n_pairs:
+            matched_r = matched_r.at[ri].set(True)
+        extra_right = mask_to_indices(~matched_r)
+
+    parts_l, parts_r = [lt], [rt]
+    if extra_left is not None and int(extra_left.shape[0]):
+        parts_l.append(left.take(extra_left))
+        parts_r.append(_null_table(right, int(extra_left.shape[0])))
+    if extra_right is not None and int(extra_right.shape[0]):
+        parts_l.append(_null_table(left, int(extra_right.shape[0])))
+        parts_r.append(right.take(extra_right))
+
+    lfull = concat_tables(parts_l) if len(parts_l) > 1 else parts_l[0]
+    rfull = concat_tables(parts_r) if len(parts_r) > 1 else parts_r[0]
+    out = Table(lfull.names + rfull.names, lfull.columns + rfull.columns)
+    return out, None
+
+
+def rejoin_outer(left: Table, right: Table, pairs_table: Table,
+                 keep_pairs: jax.Array, li: jax.Array, ri: jax.Array,
+                 join_type: str) -> Table:
+    """Apply a residual filter to matched pairs, then restore unmatched outer
+    rows (the reference's lost-row recovery, join.py:174-194)."""
+    kept = mask_to_indices(keep_pairs)
+    surviving = pairs_table.take(kept)
+    parts = [surviving]
+    if join_type in ("LEFT", "FULL"):
+        has = jnp.zeros(left.num_rows, dtype=bool)
+        lk = li[kept]
+        if int(lk.shape[0]):
+            has = has.at[lk].set(True)
+        missing = mask_to_indices(~has)
+        if int(missing.shape[0]):
+            lt = left.take(missing)
+            rt = _null_table(right, int(missing.shape[0]))
+            parts.append(Table(lt.names + rt.names, lt.columns + rt.columns))
+    if join_type in ("RIGHT", "FULL"):
+        has = jnp.zeros(right.num_rows, dtype=bool)
+        rk = ri[kept]
+        if int(rk.shape[0]):
+            has = has.at[rk].set(True)
+        missing = mask_to_indices(~has)
+        if int(missing.shape[0]):
+            lt = _null_table(left, int(missing.shape[0]))
+            rt = right.take(missing)
+            parts.append(Table(lt.names + rt.names, lt.columns + rt.columns))
+    return concat_tables(parts) if len(parts) > 1 else parts[0]
+
+
+def _null_table(src: Table, n: int) -> Table:
+    from ..table import Scalar
+    cols = []
+    for c in src.columns:
+        null_col = Column.from_scalar(Scalar(None, c.stype), n)
+        if c.stype.is_string:
+            null_col = Column(null_col.data, c.stype, null_col.mask, c.dictionary)
+        cols.append(null_col)
+    return Table(list(src.names), cols)
+
+
+def concat_tables(tables: List[Table]) -> Table:
+    """Row-wise concatenation with dictionary merging for strings."""
+    if len(tables) == 1:
+        return tables[0]
+    names = tables[0].names
+    out_cols = []
+    for ci in range(len(names)):
+        cols = [t.columns[ci] for t in tables]
+        out_cols.append(concat_columns(cols))
+    return Table(list(names), out_cols)
+
+
+def concat_columns(cols: List[Column]) -> Column:
+    t0 = cols[0]
+    if t0.stype.is_string:
+        dicts = [c.dictionary.astype(str) for c in cols]
+        union = np.unique(np.concatenate(dicts))
+        datas = []
+        for c, d in zip(cols, dicts):
+            remap = np.searchsorted(union, d).astype(np.int32)
+            datas.append(jnp.take(jnp.asarray(remap), jnp.clip(c.data, 0, max(len(d) - 1, 0))))
+        data = jnp.concatenate(datas)
+        masks = _concat_masks(cols)
+        return Column(data, t0.stype, masks, union.astype(object))
+    dt = cols[0].data.dtype
+    for c in cols[1:]:
+        dt = jnp.promote_types(dt, c.data.dtype)
+    data = jnp.concatenate([c.data.astype(dt) for c in cols])
+    return Column(data, t0.stype, _concat_masks(cols))
+
+
+def _concat_masks(cols: List[Column]):
+    if all(c.mask is None for c in cols):
+        return None
+    return jnp.concatenate([c.valid_mask() for c in cols])
+
+
+def cross_join_pairs(nl: int, nr: int) -> Tuple[jax.Array, jax.Array]:
+    li = jnp.repeat(jnp.arange(nl), nr)
+    ri = jnp.tile(jnp.arange(nr), nl)
+    return li, ri

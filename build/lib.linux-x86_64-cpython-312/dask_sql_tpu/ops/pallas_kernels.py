@@ -1,0 +1,223 @@
+"""Pallas TPU kernels for the engine's hot loops.
+
+The flagship kernel is a fused masked segmented reduction: SQL's
+``SELECT agg(x) ... GROUP BY k`` with a small static group domain (Q1 shape).
+Instead of XLA scatter-adds (slow on TPU) or a sort-based factorize, each
+row block builds its one-hot group matrix in VMEM and contracts it against
+the value rows on the MXU:
+
+    out[a, g] += sum_i vals[a, i] * (codes[i] == g & mask[i])
+
+The one-hot never touches HBM — it exists per block in VMEM — so the kernel
+is bandwidth-bound on the value stream alone, the MXU does the reduction,
+and the grid accumulates partials into the (A, G) output block across steps.
+
+The reference has no analogue (its groupby is a dask tree reduction over
+pandas partitions, aggregate.py:325-361); this is the SURVEY §7 "pallas
+kernels where XLA ops are awkward" item for groupby.
+
+On non-TPU backends the kernel runs in interpreter mode (tests), keeping one
+code path.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+BLOCK = 1024       # rows per grid step (lane-aligned multiple of 128)
+GROUP_TILE = 128   # group-axis padding (last-dim tile width)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _strategy_on_tpu() -> bool:
+    """Which KERNEL STRATEGY to trace — sort-based merge join / payload-
+    through-sort groupby (TPU-shaped: no scatters) vs hash-table join /
+    scatter groupby (host-shaped: scatters are ~1 ms where sorts are
+    hundreds).  Distinct from ``_on_tpu`` (the hardware truth, which gates
+    pallas ``interpret=``): ``DSQL_STRATEGY=tpu|host`` forces a strategy on
+    either backend — the driver bench uses ``host`` on the tunneled TPU
+    because the merge join's variadic sorts compile ~8x slower there
+    (~200 s/query) while the hash program compiles in ~25 s."""
+    s = os.environ.get("DSQL_STRATEGY", "auto").lower()
+    if s == "tpu":
+        return True
+    if s in ("host", "cpu"):
+        return False
+    return _on_tpu()
+
+
+def _seg_matmul_kernel(codes_ref, mask_ref, vals_ref, out_ref):
+    """One grid step: accumulate this row block's per-group partial sums."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    codes = codes_ref[:]                      # (1, BLOCK) int32
+    mask = mask_ref[:]                        # (1, BLOCK) bool
+    g = out_ref.shape[1]
+    onehot = (codes.reshape(-1, 1)
+              == jax.lax.broadcasted_iota(jnp.int32, (codes.shape[1], g), 1))
+    onehot = jnp.where(mask.reshape(-1, 1), onehot, False)
+    onehot = onehot.astype(out_ref.dtype)
+    out_ref[:] += jnp.dot(vals_ref[:].astype(out_ref.dtype), onehot,
+                          preferred_element_type=out_ref.dtype)
+
+
+def segmented_sums(vals: jax.Array, codes: jax.Array, mask: jax.Array,
+                   num_groups: int, *, interpret: bool | None = None
+                   ) -> jax.Array:
+    """Masked segmented sums of A value rows over a static group domain.
+
+    vals: (A, n) float; codes: (n,) ints in [0, num_groups); mask: (n,) bool.
+    Returns (A, num_groups) sums of vals[:, i] over rows with codes[i]==g and
+    mask[i]. Jit/trace-safe; static shapes only.
+
+    Non-finite safety: the one-hot contraction computes vals * 0 for other
+    groups, and NaN/Inf * 0 == NaN would poison every group. The kernel
+    therefore sums sanitized values and per-group NaN/+Inf/-Inf indicator
+    rows, and reconstitutes IEEE semantics afterwards.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _nonfinite_safe(
+        lambda v, c, m, g: _segmented_sums_finite(v, c, m, g, interpret)
+    )(vals, codes, mask, num_groups)
+
+
+def _segmented_sums_finite(vals: jax.Array, codes: jax.Array, mask: jax.Array,
+                           num_groups: int, interpret: bool) -> jax.Array:
+    a, n = vals.shape
+    g_pad = max(GROUP_TILE, -(-num_groups // GROUP_TILE) * GROUP_TILE)
+    n_pad = -(-n // BLOCK) * BLOCK
+    if n_pad != n:
+        vals = jnp.pad(vals, ((0, 0), (0, n_pad - n)))
+        codes = jnp.pad(codes, (0, n_pad - n))
+        mask = jnp.pad(mask, (0, n_pad - n))  # padded rows masked out
+    codes = codes.astype(jnp.int32).reshape(1, n_pad)
+    mask = mask.reshape(1, n_pad)
+    out_dtype = vals.dtype if jnp.issubdtype(vals.dtype, jnp.floating) \
+        else jnp.float64
+    grid = n_pad // BLOCK
+    out = pl.pallas_call(
+        _seg_matmul_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK), lambda i: (0, i)),
+            pl.BlockSpec((1, BLOCK), lambda i: (0, i)),
+            pl.BlockSpec((a, BLOCK), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((a, g_pad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((a, g_pad), out_dtype),
+        interpret=interpret,
+    )(codes, mask, vals)
+    return out[:, :num_groups]
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "interpret"))
+def segmented_sums_jit(vals, codes, mask, num_groups, interpret=None):
+    return segmented_sums(vals, codes, mask, num_groups, interpret=interpret)
+
+
+def segmented_sums_xla_blocked(vals: jax.Array, codes: jax.Array,
+                               mask: jax.Array, num_groups: int,
+                               block: int = 4096) -> jax.Array:
+    """One-hot contraction via an XLA scan over row blocks.
+
+    Same math as the pallas kernel but in plain XLA: Mosaic has no 64-bit
+    support, so this is the f64 path on TPU (X64 emulation is exact). The
+    per-block one-hot lives only inside the scan body — peak memory is one
+    (block, G) tile, not (n, G). Callers handle non-finite values
+    (segmented_sums_dispatch wraps with the sanitize/indicator machinery).
+    """
+    a, n = vals.shape
+    out_dtype = vals.dtype if jnp.issubdtype(vals.dtype, jnp.floating) \
+        else jnp.float64
+    n_pad = -(-max(n, 1) // block) * block
+    if n_pad != n:
+        vals = jnp.pad(vals, ((0, 0), (0, n_pad - n)))
+        codes = jnp.pad(codes, (0, n_pad - n))
+        mask = jnp.pad(mask, (0, n_pad - n))
+    nb = n_pad // block
+    vb = vals.reshape(a, nb, block).transpose(1, 0, 2).astype(out_dtype)
+    cb = codes.astype(jnp.int32).reshape(nb, block)
+    mb = mask.reshape(nb, block)
+
+    def step(acc, xs):
+        v, c, m = xs
+        onehot = (c[:, None]
+                  == jax.lax.broadcasted_iota(jnp.int32, (block, num_groups), 1))
+        onehot = jnp.where(m[:, None], onehot, False).astype(out_dtype)
+        return acc + jnp.dot(v, onehot, preferred_element_type=out_dtype), None
+
+    acc0 = jnp.zeros((a, num_groups), dtype=out_dtype)
+    out, _ = jax.lax.scan(step, acc0, (vb, cb, mb))
+    return out
+
+
+def segmented_sums_dispatch(vals: jax.Array, codes: jax.Array,
+                            mask: jax.Array, num_groups: int) -> jax.Array:
+    """Backend policy for the static-domain groupby reduction.
+
+    - DSQL_PALLAS=force: pallas kernel (interpreted off-TPU) — test hook.
+    - TPU + 32-bit floats: the pallas MXU kernel.
+    - TPU + 64-bit: XLA blocked contraction (Mosaic has no 64-bit types).
+    - otherwise (CPU/GPU): XLA scatter segment-sum, which is fine there.
+    Non-finite safety is applied here once for every backend.
+    """
+    import os
+
+    forced = os.environ.get("DSQL_PALLAS") == "force"
+    if forced:
+        return segmented_sums(vals, codes, mask, num_groups,
+                              interpret=not _on_tpu())
+    if _on_tpu():
+        if vals.dtype == jnp.float32:
+            return segmented_sums(vals, codes, mask, num_groups,
+                                  interpret=False)
+        return _nonfinite_safe(segmented_sums_xla_blocked)(
+            vals, codes, mask, num_groups)
+    return reference_segmented_sums(vals, codes, mask, num_groups)
+
+
+def _nonfinite_safe(backend):
+    """Wrap a sanitized-sum backend with NaN/Inf indicator reassembly."""
+    def wrapped(vals, codes, mask, num_groups):
+        if not jnp.issubdtype(vals.dtype, jnp.floating):
+            return backend(vals, codes, mask, num_groups)
+        from .sorted_agg import ieee_reassemble
+        a = vals.shape[0]
+        isnan = jnp.isnan(vals)
+        ispos = jnp.isposinf(vals)
+        isneg = jnp.isneginf(vals)
+        clean = jnp.where(isnan | ispos | isneg, 0.0, vals)
+        stacked = jnp.concatenate([
+            clean, isnan.astype(vals.dtype), ispos.astype(vals.dtype),
+            isneg.astype(vals.dtype)])
+        sums = backend(stacked, codes, mask, num_groups)
+        return ieee_reassemble(sums[:a], sums[a:2 * a], sums[2 * a:3 * a],
+                               sums[3 * a:])
+    return wrapped
+
+
+def reference_segmented_sums(vals, codes, mask, num_groups):
+    """XLA scatter-based oracle for tests (where, not multiply, so masked
+    NaN rows contribute nothing)."""
+    out_dtype = vals.dtype if jnp.issubdtype(vals.dtype, jnp.floating) \
+        else jnp.float64
+    return jnp.stack([
+        jax.ops.segment_sum(
+            jnp.where(mask, vals[i].astype(out_dtype), 0), codes, num_groups)
+        for i in range(vals.shape[0])])

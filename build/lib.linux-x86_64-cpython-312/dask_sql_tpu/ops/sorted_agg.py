@@ -1,0 +1,171 @@
+"""Scatter-free segmented aggregation over group-sorted rows.
+
+``jax.ops.segment_*`` lowers to scatter, which TPUs execute painfully
+(serialized updates); measured on the bench workload a single 120k-row
+segment_sum cost ~250ms on-chip. Everything here uses the TPU-fast
+primitives instead: cumulative sums, ``searchsorted`` gathers, and
+log-depth ``associative_scan`` — no scatter anywhere.
+
+Layout contract: rows are sorted by group code ascending (invalid rows
+sorted past all real codes), so segment g occupies the half-open range
+[starts[g], ends[g]) given by binary search. Aggregates are prefix-sum
+differences (SUM/COUNT family) or segmented scans (MIN/MAX/first/last).
+
+Non-finite safety for sums: a NaN in the value stream would poison every
+later group through the running prefix; sums are computed over sanitized
+values plus NaN/+Inf/-Inf indicator counts and reassembled with IEEE
+semantics (shared with the pallas MXU kernel, ops/pallas_kernels.py).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+US = jnp.uint64
+
+
+def ieee_reassemble(clean: jax.Array, nan_c: jax.Array, pos_c: jax.Array,
+                    neg_c: jax.Array) -> jax.Array:
+    """Recombine a sanitized sum with non-finite indicator counts."""
+    out = jnp.where(pos_c > 0, jnp.inf, clean)
+    out = jnp.where(neg_c > 0, -jnp.inf, out)
+    out = jnp.where((pos_c > 0) & (neg_c > 0), jnp.nan, out)
+    return jnp.where(nan_c > 0, jnp.nan, out)
+
+
+def segment_bounds(codes_sorted: jax.Array, cap: int
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """[starts, ends) of each group slot in the sorted code stream.
+
+    Codes are DENSE ranks 0..ngroups-1 (ascending; slot ``cap`` is the
+    invalid-row trash region), so the k-th group boundary in the stream IS
+    the start of slot k. A single-operand sort of the boundary positions is
+    ~10x cheaper on TPU than searchsorted's (n+cap)-element key+payload sort
+    (measured on the bench workload: 57ms -> 4ms at 1.8M rows).
+    """
+    n = codes_sorted.shape[0]
+    valid = codes_sorted < cap
+    boundary = valid & jnp.concatenate(
+        [jnp.ones(1, dtype=bool), codes_sorted[1:] != codes_sorted[:-1]])
+    pos = jnp.where(boundary, jnp.arange(n, dtype=jnp.int64), n)
+    pos = jnp.sort(pos)
+    if n < cap:
+        pos = jnp.concatenate([pos, jnp.full(cap - n, n, dtype=jnp.int64)])
+    starts = pos[:cap]
+    nvalid = jnp.sum(valid.astype(jnp.int64))
+    # empty slots (>= ngroups) collapse to [nvalid, nvalid), matching the
+    # previous searchsorted contract
+    ends = jnp.minimum(
+        jnp.concatenate([starts[1:], jnp.full(1, n, dtype=jnp.int64)]), nvalid)
+    starts = jnp.minimum(starts, nvalid)
+    return starts, ends
+
+
+def _prefix(x: jax.Array) -> jax.Array:
+    """Exclusive-prefix-friendly cumsum: prefix[i] = sum(x[:i])."""
+    return jnp.concatenate([jnp.zeros(1, dtype=x.dtype), jnp.cumsum(x)])
+
+
+def seg_count(valid: jax.Array, starts: jax.Array, ends: jax.Array
+              ) -> jax.Array:
+    p = _prefix(valid.astype(jnp.int64))
+    return p[ends] - p[starts]
+
+
+def seg_sum(values: jax.Array, valid: jax.Array, codes_sorted: jax.Array,
+            starts: jax.Array, ends: jax.Array) -> jax.Array:
+    """Masked segmented sum.
+
+    Integers ride the exact prefix-sum difference (int64 modular arithmetic
+    cancels exactly). Floats use the segmented SCAN instead: a global
+    prefix would mix group magnitudes — one 1e18 group catastrophically
+    cancels every later group's sum — and the per-group scan also keeps
+    NaN/Inf confined to their own group for free (the scan resets at each
+    boundary), matching per-group sequential accumulation exactly.
+    """
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        v = jnp.where(valid, values.astype(jnp.float64), 0.0)
+        return seg_reduce_scan_codes(v, jnp.ones(v.shape[0], bool),
+                                     codes_sorted, ends, jnp.add, 0.0,
+                                     starts=starts)
+    work = jnp.where(valid, values.astype(jnp.int64), 0)
+    p = _prefix(work)
+    return p[ends] - p[starts]
+
+
+def _segmented_scan(values: jax.Array, segment_start: jax.Array, combine):
+    """Inclusive segmented scan: resets at segment starts. Returns the
+    running reduction; element ends[g]-1 holds segment g's total."""
+
+    def op(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, combine(av, bv)), af | bf
+
+    out, _ = jax.lax.associative_scan(op, (values, segment_start))
+    return out
+
+
+def seg_reduce_scan_codes(values: jax.Array, valid: jax.Array,
+                          codes_sorted: jax.Array, ends: jax.Array,
+                          combine, identity,
+                          starts: Optional[jax.Array] = None) -> jax.Array:
+    """Segmented reduction via log-depth scan over the sorted stream; start
+    flags come from comparing adjacent sorted codes — fully scatter-free.
+    With ``starts`` given, empty slots return ``identity`` instead of the
+    neighbouring segment's total (the gather at ends-1 lands in the
+    previous segment when ends == starts)."""
+    n = values.shape[0]
+    if n == 0:
+        return jnp.full(ends.shape, identity, dtype=values.dtype)
+    flags = jnp.concatenate([
+        jnp.ones(1, dtype=bool), codes_sorted[1:] != codes_sorted[:-1]])
+    work = jnp.where(valid, values, identity)
+    scanned = _segmented_scan(work, flags, combine)
+    pos = jnp.clip(ends - 1, 0, n - 1)
+    out = scanned[pos]
+    if starts is not None:
+        out = jnp.where(ends > starts, out,
+                        jnp.asarray(identity, dtype=out.dtype))
+    return out
+
+
+def seg_min(values, valid, codes_sorted, ends):
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        ident = jnp.inf
+    elif values.dtype == jnp.bool_:
+        values, ident = values.astype(jnp.int64), 1
+    else:
+        ident = jnp.iinfo(values.dtype).max
+    return seg_reduce_scan_codes(values, valid, codes_sorted, ends,
+                                 jnp.minimum, ident)
+
+
+def seg_max(values, valid, codes_sorted, ends):
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        ident = -jnp.inf
+    elif values.dtype == jnp.bool_:
+        values, ident = values.astype(jnp.int64), 0
+    else:
+        ident = jnp.iinfo(values.dtype).min
+    return seg_reduce_scan_codes(values, valid, codes_sorted, ends,
+                                 jnp.maximum, ident)
+
+
+def seg_first_valid_pos(valid: jax.Array, codes_sorted: jax.Array,
+                        ends: jax.Array) -> jax.Array:
+    """Sorted-stream position of each segment's first valid row (n if none)."""
+    n = valid.shape[0]
+    idx = jnp.where(valid, jnp.arange(n, dtype=jnp.int64), n)
+    return seg_reduce_scan_codes(idx, jnp.ones(n, bool), codes_sorted, ends,
+                                 jnp.minimum, n)
+
+
+def seg_last_valid_pos(valid: jax.Array, codes_sorted: jax.Array,
+                       ends: jax.Array) -> jax.Array:
+    n = valid.shape[0]
+    idx = jnp.where(valid, jnp.arange(n, dtype=jnp.int64), -1)
+    return seg_reduce_scan_codes(idx, jnp.ones(n, bool), codes_sorted, ends,
+                                 jnp.maximum, -1)

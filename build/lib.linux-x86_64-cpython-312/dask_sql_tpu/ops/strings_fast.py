@@ -1,0 +1,237 @@
+"""High-cardinality string matching: vectorized host bitmaps + a device
+bytes-matrix LIKE kernel.
+
+The baseline string path walks the (small) dictionary with per-entry Python
+regex — perfect at TPC-H cardinalities, a cliff at ~1M distinct values
+(reference semantics: call.py:287-385's LIKE transpiler).  Two escape
+hatches, picked per call:
+
+- ``like_bitmap_vectorized``: LIKE patterns made of literal chunks
+  separated by ``%`` (no ``_``) evaluate over the whole dictionary with
+  ``np.strings`` kernels (startswith / endswith / find-with-array-starts) —
+  one C pass per chunk instead of one Python regex call per entry.
+- ``device_like_bitmap``: above ``DSQL_DEVICE_STRING_THRESHOLD`` distinct
+  values the dictionary is padded into a device-resident ``[D, L]`` uint8
+  bytes matrix (built once per dictionary, memoized) and chunk matching
+  runs as shifted byte comparisons on the accelerator; the per-entry bool
+  bitmap comes back and rows map via the usual code gather.
+
+Both produce the same per-dictionary-entry bitmap the regex path produces;
+callers fall back to regex for patterns outside the chunk grammar
+(``_`` wildcards, SIMILAR TO).
+"""
+from __future__ import annotations
+
+import os
+import weakref
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEVICE_STRING_THRESHOLD = int(
+    os.environ.get("DSQL_DEVICE_STRING_THRESHOLD", str(1 << 15)))
+_MAX_DEVICE_STR_LEN = 128
+
+stats = {"device_bitmaps": 0}   # observability for tests/benchmarks
+
+
+def parse_like_chunks(pattern: str, escape: Optional[str]
+                      ) -> Optional[Tuple[List[str], bool, bool]]:
+    """(chunks, anchor_start, anchor_end) for %-separated literal patterns;
+    None when the pattern needs full regex (``_`` wildcard)."""
+    chunks: List[str] = []
+    cur: List[str] = []
+    i = 0
+    n = len(pattern)
+    ends_wild = False
+    while i < n:
+        c = pattern[i]
+        if escape and c == escape and i + 1 < n:
+            cur.append(pattern[i + 1])
+            ends_wild = False
+            i += 2
+            continue
+        if c == "_":
+            return None
+        if c == "%":
+            if cur:
+                chunks.append("".join(cur))
+                cur = []
+            ends_wild = True
+        else:
+            cur.append(c)
+            ends_wild = False
+        i += 1
+    if cur:
+        chunks.append("".join(cur))
+    anchor_start = bool(pattern) and pattern[0] != "%"
+    anchor_end = bool(pattern) and not ends_wild
+    return chunks, anchor_start, anchor_end
+
+
+def like_bitmap_vectorized(d: np.ndarray, pattern: str,
+                           escape: Optional[str],
+                           kind: str) -> Optional[np.ndarray]:
+    """Per-dictionary-entry LIKE bitmap via np.strings; None = not eligible."""
+    if kind == "SIMILAR":
+        return None
+    parsed = parse_like_chunks(pattern, escape)
+    if parsed is None:
+        return None
+    chunks, anchor_start, anchor_end = parsed
+    s = np.asarray(d, dtype=str)
+    if kind == "ILIKE":
+        s = np.strings.lower(s)
+        chunks = [c.lower() for c in chunks]
+    D = len(s)
+    if not chunks:
+        if pattern == "":
+            return np.strings.str_len(s) == 0  # LIKE '' matches only ''
+        return np.ones(D, dtype=bool)  # '%', '%%', ... match everything
+    if len(chunks) == 1 and anchor_start and anchor_end:
+        return s == chunks[0]
+    ok = np.ones(D, dtype=bool)
+    slen = np.strings.str_len(s)
+    pos = np.zeros(D, dtype=np.int64)
+    last = len(chunks) - 1
+    for i, chunk in enumerate(chunks):
+        if i == 0 and anchor_start:
+            ok &= np.strings.startswith(s, chunk)
+            pos = np.full(D, len(chunk), dtype=np.int64)
+            continue
+        if i == last and anchor_end:
+            ok &= np.strings.endswith(s, chunk)
+            ok &= (slen - len(chunk)) >= pos
+            continue
+        idx = np.strings.find(s, chunk, pos, slen)
+        ok &= idx >= 0
+        pos = np.where(idx >= 0, idx + len(chunk), pos)
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# device bytes-matrix path
+# ---------------------------------------------------------------------------
+
+# id(dictionary) -> (weakref, np str-dtype copy): the object->U astype over
+# a large dictionary costs more than the matching itself — convert once
+_str_memo: dict = {}
+
+
+def dict_as_str(dictionary: np.ndarray) -> np.ndarray:
+    key = id(dictionary)
+    hit = _str_memo.get(key)
+    if hit is not None and hit[0]() is dictionary:
+        return hit[1]
+    s = np.asarray(dictionary, dtype=str)
+    _str_memo[key] = (
+        weakref.ref(dictionary, lambda _r, k=key: _str_memo.pop(k, None)), s)
+    return s
+
+
+# id(dictionary) -> (weakref, device_bytes [D, L] uint8, lens [D] int32,
+#                    all_ascii)
+_matrix_memo: dict = {}
+
+
+def _bytes_matrix(dictionary: np.ndarray):
+    """Device-resident padded bytes matrix for a dictionary, or None when
+    the dictionary holds strings too long for the fixed-width layout."""
+    key = id(dictionary)
+    hit = _matrix_memo.get(key)
+    if hit is not None and hit[0]() is dictionary:
+        return hit[1], hit[2], hit[3]
+    encoded = [str(v).encode("utf-8") for v in dictionary]
+    L = max((len(b) for b in encoded), default=1)
+    if L > _MAX_DEVICE_STR_LEN:
+        return None
+    L = max(L, 1)
+    D = len(encoded)
+    mat = np.zeros((D, L), dtype=np.uint8)
+    lens = np.empty(D, dtype=np.int32)
+    for i, b in enumerate(encoded):
+        lens[i] = len(b)
+        mat[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+    all_ascii = bool((mat < 128).all())
+    dev_mat = jnp.asarray(mat)
+    dev_lens = jnp.asarray(lens)
+    _matrix_memo[key] = (
+        weakref.ref(dictionary, lambda _r, k=key: _matrix_memo.pop(k, None)),
+        dev_mat, dev_lens, all_ascii)
+    return dev_mat, dev_lens, all_ascii
+
+
+def _chunk_occurrences(B: jax.Array, lens: jax.Array, chunk: bytes):
+    """occ[d, j]: chunk matches B[d] at byte offset j (window within len)."""
+    D, L = B.shape
+    m = len(chunk)
+    if m > L:
+        # chunk longer than every dictionary string: no row matches; w=1
+        # keeps downstream argmax/take shapes valid (all-False column)
+        return jnp.zeros((D, 1), dtype=bool), 1
+    w = L - m + 1
+    acc = jnp.ones((D, w), dtype=bool)
+    for k, byte in enumerate(chunk):
+        acc = acc & (B[:, k:k + w] == np.uint8(byte))
+    win_ok = (jnp.arange(w)[None, :] + m) <= lens[:, None]
+    return acc & win_ok, w
+
+
+def device_like_bitmap(dictionary: np.ndarray, pattern: str,
+                       escape: Optional[str], kind: str
+                       ) -> Optional[jax.Array]:
+    """Per-dictionary-entry LIKE bitmap computed ON DEVICE; None when the
+    pattern/dictionary is outside the device grammar (regex fallback)."""
+    if kind == "SIMILAR":
+        return None
+    parsed = parse_like_chunks(pattern, escape)
+    if parsed is None:
+        return None
+    chunks, anchor_start, anchor_end = parsed
+    built = _bytes_matrix(dictionary)
+    if built is None:
+        return None
+    B, lens, all_ascii = built
+    if kind == "ILIKE":
+        if not (all_ascii and pattern.isascii()):
+            return None  # non-ASCII case folding needs the host path
+        B = jnp.where((B >= 65) & (B <= 90), B + 32, B)
+        chunks = [c.lower() for c in chunks]
+    try:
+        enc = [c.encode("utf-8") for c in chunks]
+    except UnicodeEncodeError:  # pragma: no cover
+        return None
+    D = B.shape[0]
+    if not enc:
+        if pattern == "":
+            return lens == 0  # LIKE '' matches only ''
+        return jnp.ones(D, dtype=bool)
+    ok = jnp.ones(D, dtype=bool)
+    pos = jnp.zeros(D, dtype=jnp.int32)
+    last = len(enc) - 1
+    for i, chunk in enumerate(enc):
+        m = len(chunk)
+        if i == 0 and anchor_start and i == last and anchor_end:
+            # exact equality: prefix match + exact length
+            occ, _ = _chunk_occurrences(B, lens, chunk)
+            ok = ok & occ[:, 0] & (lens == m)
+            continue
+        occ, w = _chunk_occurrences(B, lens, chunk)
+        if i == 0 and anchor_start:
+            ok = ok & occ[:, 0]
+            pos = jnp.full(D, m, dtype=jnp.int32)
+            continue
+        if i == last and anchor_end:
+            at = jnp.clip(lens - m, 0, w - 1)
+            end_hit = jnp.take_along_axis(occ, at[:, None].astype(jnp.int32),
+                                          axis=1)[:, 0]
+            ok = ok & end_hit & (lens - m >= pos)
+            continue
+        cand = occ & (jnp.arange(w)[None, :] >= pos[:, None])
+        found = cand.any(axis=1)
+        idx = jnp.argmax(cand, axis=1)
+        ok = ok & found
+        pos = jnp.where(found, idx + m, pos).astype(jnp.int32)
+    return ok

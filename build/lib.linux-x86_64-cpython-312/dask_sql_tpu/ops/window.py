@@ -1,0 +1,433 @@
+"""Window-function kernels: sharded segmented scans instead of gather-to-one.
+
+The reference collapses each PARTITION BY group to a single pandas partition
+via groupby().apply (/root/reference/dask_sql/physical/rel/logical/
+window.py:152-205) — a scalability cliff SURVEY §5 calls out.  Here windows
+are computed as sorted segmented scans: lexsort by (partition, order keys),
+run prefix-scan kernels, gather back to row order.
+
+Everything on the main path is jit-trace-safe (no host syncs, static
+shapes, no scatters): the compiled whole-plan executor
+(physical/compiled.py) calls ``compute_window`` directly inside its trace;
+only NTILE/LAG/LEAD/NTH_VALUE read their constant arguments from column
+data on the host and stay eager-only.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..table import dict_sort_order, Column, Scalar, Table
+from ..types import SqlType, physical_dtype
+from .kernels import (append_lexsort_operands, comparable_data, key_parts)
+
+# window ops whose kernels are fully trace-safe (the compiled executor's
+# supported subset; the rest read host constants)
+TRACE_SAFE_OPS = frozenset({
+    "ROW_NUMBER", "RANK", "DENSE_RANK", "PERCENT_RANK", "CUME_DIST",
+    "COUNT", "SUM", "$SUM0", "AVG", "MIN", "MAX",
+    "FIRST_VALUE", "LAST_VALUE", "SINGLE_VALUE",
+})
+
+
+def _segment_starts(codes_sorted: jax.Array) -> jax.Array:
+    n = codes_sorted.shape[0]
+    if n == 0:
+        return jnp.zeros(0, dtype=bool)
+    first = jnp.ones(1, dtype=bool)
+    rest = codes_sorted[1:] != codes_sorted[:-1]
+    return jnp.concatenate([first, rest])
+
+
+def _segment_ids(starts: jax.Array) -> jax.Array:
+    return jnp.cumsum(starts.astype(jnp.int64)) - 1
+
+
+def _adjacent_diff(channels, n: int) -> jax.Array:
+    """Row 0 True; row i True iff ANY channel differs from row i-1.
+    Channels are already sorted streams — boundary detection without
+    post-sort gathers (group equality == equality of every sort channel)."""
+    if n == 0:
+        return jnp.zeros(0, dtype=bool)
+    diff = jnp.zeros(n - 1, dtype=bool)
+    for ch in channels:
+        diff = diff | (ch[1:] != ch[:-1])
+    return jnp.concatenate([jnp.ones(1, dtype=bool), diff])
+
+
+def segmented_cumsum(x: jax.Array, starts: jax.Array) -> jax.Array:
+    """Inclusive prefix sum that resets at segment starts (trace-safe:
+    log-depth segmented scan, no data-dependent shapes)."""
+    return segmented_scan(x, starts, jnp.add)
+
+
+def segmented_scan(x: jax.Array, starts: jax.Array, combine) -> jax.Array:
+    """Generic inclusive segmented scan via associative_scan on (flag, value)."""
+
+    def op(a, b):
+        fa, va = a
+        fb, vb = b
+        return (fa | fb, jnp.where(fb, vb, combine(va, vb)))
+
+    flags = starts
+    _, out = jax.lax.associative_scan(op, (flags, x))
+    return out
+
+
+def window_frame_sums(x: jax.Array, seg_start: jax.Array, seg_end: jax.Array,
+                      lo: Optional[int], hi: Optional[int]):
+    """Moving SUM/COUNT over ROWS frames using prefix sums.
+
+    lo/hi are row offsets relative to current (negative = preceding); None =
+    unbounded on that side. seg_start/seg_end are PER-ROW positions of the
+    row's segment bounds in sorted order.
+    """
+    n = x.shape[0]
+    prefix = jnp.cumsum(x)
+    idx = jnp.arange(n)
+    start = seg_start if lo is None else jnp.maximum(idx + lo, seg_start)
+    end = seg_end if hi is None else jnp.minimum(idx + hi, seg_end)
+    end = jnp.minimum(end, n - 1)
+    start = jnp.maximum(start, 0)
+    upper = prefix[end]
+    lower = jnp.where(start > 0, prefix[jnp.maximum(start - 1, 0)], 0)
+    empty = end < start
+    return jnp.where(empty, 0, upper - lower)
+
+
+def compute_window(table: Table, op: str, arg_cols: List[int],
+                   partition_cols: List[int],
+                   order_keys: List[Tuple[int, bool, bool]],
+                   frame, stype: SqlType,
+                   row_valid: Optional[jax.Array] = None) -> Column:
+    """Compute one window call; returns a column aligned with table rows.
+
+    ``row_valid`` (compiled-executor mode): invalid/padding rows sort into
+    their own trailing segment so they never contaminate real partitions;
+    their outputs are garbage and must be masked by the caller's validity.
+    """
+    n = table.num_rows
+    if n == 0:
+        return Column(jnp.zeros(0, dtype=physical_dtype(stype)), stype)
+
+    from .pallas_kernels import _strategy_on_tpu as _on_tpu
+    on_tpu = _on_tpu()
+
+    # 1. sort by (validity, partition, order keys) — trace-safe: partitions
+    # come from key-part comparisons, not a factorize. Arrays are built
+    # least-significant-first (jnp.lexsort order); the argument column rides
+    # the sort as a payload operand on TPU, where a random n-element gather
+    # costs ~2x a whole extra sort operand (profiled on the join path).
+    arrays = []
+    for idx, asc, nulls_first in reversed(order_keys):
+        col = table.columns[idx]
+        data = comparable_data(col)
+        if jnp.issubdtype(data.dtype, jnp.integer):
+            data = data.astype(jnp.int64)
+        if not asc:
+            data = -data if not jnp.issubdtype(data.dtype, jnp.bool_) else ~data
+        if col.mask is not None:
+            nullkey = (~col.mask).astype(jnp.int8)
+            arrays.append(data)
+            arrays.append(nullkey if not nulls_first else -nullkey)
+        else:
+            arrays.append(data)
+    n_ord_ops = len(arrays)
+    part_parts = key_parts([table.columns[i] for i in partition_cols]) \
+        if partition_cols else []
+    append_lexsort_operands(arrays, list(reversed(part_parts)))
+    if row_valid is not None:
+        arrays.append((~row_valid).astype(jnp.int8))  # invalid rows last
+
+    pay: List[jax.Array] = []
+    arg_slot = None
+    arg_col0 = table.columns[arg_cols[0]] if arg_cols else None
+    if arg_col0 is not None and op != "NTILE":
+        arg_slot = (len(pay), arg_col0.mask is not None)
+        pay.append(arg_col0.data)
+        if arg_col0.mask is not None:
+            pay.append(arg_col0.mask)
+
+    keys_msf = list(reversed(arrays))  # most significant first
+    if not keys_msf:
+        perm = jnp.arange(n)
+        keys_sorted: List[jax.Array] = []
+        pay_sorted = list(pay)
+    elif on_tpu:
+        iota = jnp.arange(n, dtype=jnp.int64)
+        outs = jax.lax.sort(tuple(keys_msf) + (iota,) + tuple(pay),
+                            num_keys=len(keys_msf), is_stable=True)
+        perm = outs[len(keys_msf)]
+        keys_sorted = list(outs[:len(keys_msf)])
+        pay_sorted = list(outs[len(keys_msf) + 1:])
+    else:
+        perm = jnp.lexsort(tuple(arrays))
+        keys_sorted = [k[perm] for k in keys_msf]
+        pay_sorted = [p[perm] for p in pay]
+
+    def sorted_arg() -> Column:
+        di, has_mask = arg_slot
+        return Column(pay_sorted[di], arg_col0.stype,
+                      pay_sorted[di + 1] if has_mask else None,
+                      arg_col0.dictionary)
+
+    # 2. segment starts from adjacent diffs over the SORTED partition (and
+    # validity) channels — no gathers; tie groups reuse the order channels
+    n_seg_ops = len(keys_msf) - n_ord_ops
+    starts = _adjacent_diff(keys_sorted[:n_seg_ops], n)
+    tie = _adjacent_diff(keys_sorted[n_seg_ops:], n) & ~starts \
+        if order_keys else jnp.zeros(n, dtype=bool)
+    pos = jnp.arange(n)
+    # per-row segment bounds via forward/backward segmented scans
+    seg_start = segmented_scan(pos, starts, jnp.minimum)
+    # reversed-stream segment starts: original row i is last-of-segment iff
+    # i == n-1 or starts[i+1]; flipping that gives the reverse-scan flags
+    ends_flags = jnp.concatenate([jnp.ones(1, bool), jnp.flip(starts[1:])])
+    seg_end = jnp.flip(segmented_scan(jnp.flip(pos), ends_flags, jnp.maximum))
+    row_in_seg = pos - seg_start
+
+    # frame bounds as offsets
+    lo_off, hi_off = _frame_offsets(op, frame, bool(order_keys))
+
+    def scatter_back(sorted_vals, mask_sorted=None):
+        # un-sort to original row order: payload sort on TPU, argsort +
+        # gather elsewhere (mirrors the join/groupby backend split)
+        if on_tpu:
+            chs = ((perm, sorted_vals) if mask_sorted is None
+                   else (perm, sorted_vals, mask_sorted))
+            outs2 = jax.lax.sort(chs, num_keys=1)
+            out = outs2[1]
+            m = outs2[2] if mask_sorted is not None else None
+        else:
+            inv_perm = jnp.argsort(perm)
+            out = sorted_vals[inv_perm]
+            m = None if mask_sorted is None else mask_sorted[inv_perm]
+        return Column(out.astype(physical_dtype(stype)) if not stype.is_string else out,
+                      stype, m)
+
+    if op == "ROW_NUMBER":
+        return scatter_back(row_in_seg + 1)
+
+    if op in ("RANK", "DENSE_RANK", "PERCENT_RANK", "CUME_DIST"):
+        # rank = position of the first row of the current tie group:
+        # propagate the last tie/segment start forward within the segment
+        tie_start = segmented_scan(jnp.where(tie | starts, pos, -1), starts,
+                                   jnp.maximum)
+        rank = tie_start - seg_start + 1
+        if op == "RANK":
+            return scatter_back(rank)
+        if op == "PERCENT_RANK":
+            seg_len = seg_end - seg_start + 1
+            pr = jnp.where(seg_len > 1, (rank - 1) / jnp.maximum(seg_len - 1, 1), 0.0)
+            return scatter_back(pr)
+        if op == "CUME_DIST":
+            seg_len = seg_end - seg_start + 1
+            # number of rows with order key <= current = end of tie group
+            is_last_of_tie = jnp.concatenate([tie[1:] | starts[1:], jnp.ones(1, bool)])
+            tie_end = _backward_fill_positions(pos, is_last_of_tie, seg_end)
+            return scatter_back((tie_end - seg_start + 1) / seg_len)
+        # DENSE_RANK: count of tie-group starts up to here within segment
+        dr = segmented_cumsum((tie | starts).astype(jnp.int64), starts)
+        return scatter_back(dr)
+
+    if op == "NTILE":
+        k = int(np.asarray(table.columns[arg_cols[0]].data)[0]) if arg_cols else 1
+        seg_len = seg_end - seg_start + 1
+        out = (row_in_seg * k) // jnp.maximum(seg_len, 1) + 1
+        return scatter_back(out)
+
+    if op in ("LAG", "LEAD"):
+        col = table.columns[arg_cols[0]]
+        offset = 1
+        if len(arg_cols) > 1:
+            offset = int(np.asarray(table.columns[arg_cols[1]].data)[0])
+        shift = -offset if op == "LAG" else offset
+        src = pos + shift
+        valid = (src >= seg_start) & (src <= seg_end)
+        src = jnp.clip(src, 0, n - 1)
+        sorted_col = sorted_arg()
+        gathered = sorted_col.take(src)
+        m = gathered.valid_mask() & valid
+        out = scatter_back(gathered.data, m)
+        if col.stype.is_string:
+            return Column(out.data.astype(jnp.int32), stype, out.mask, col.dictionary)
+        return out
+
+    if op in ("FIRST_VALUE", "LAST_VALUE", "NTH_VALUE"):
+        col = sorted_arg()
+        if op == "FIRST_VALUE":
+            src = seg_start
+        elif op == "LAST_VALUE":
+            # default frame = up to CURRENT ROW when ORDER BY present
+            if order_keys and frame is None:
+                src = pos
+            else:
+                src = seg_end
+        else:
+            k = int(np.asarray(table.columns[arg_cols[1]].data)[0])
+            src = seg_start + (k - 1)
+            src = jnp.minimum(src, seg_end)
+        gathered = col.take(src)
+        out = scatter_back(gathered.data,
+                           gathered.mask if gathered.mask is not None else None)
+        if col.stype.is_string:
+            return Column(out.data.astype(jnp.int32), stype, out.mask, col.dictionary)
+        return out
+
+    # aggregate window functions
+    if op in ("COUNT",):
+        if arg_cols:
+            col = sorted_arg()
+            x = col.valid_mask().astype(jnp.int64)
+        else:
+            x = jnp.ones(n, dtype=jnp.int64)
+        out = window_frame_sums(x, seg_start, seg_end, lo_off, hi_off)
+        return scatter_back(out)
+
+    if op in ("SUM", "$SUM0", "AVG"):
+        col = sorted_arg()
+        valid = col.valid_mask()
+        data = jnp.where(valid, col.data, 0)
+        if jnp.issubdtype(data.dtype, jnp.integer):
+            data = data.astype(jnp.int64)
+        else:
+            data = data.astype(jnp.float64)
+        s = window_frame_sums(data, seg_start, seg_end, lo_off, hi_off)
+        c = window_frame_sums(valid.astype(jnp.int64), seg_start, seg_end,
+                              lo_off, hi_off)
+        if op == "AVG":
+            out = s / jnp.maximum(c, 1)
+            return scatter_back(out, (c > 0))
+        if op == "$SUM0":
+            return scatter_back(s)
+        return scatter_back(s, (c > 0))
+
+    if op in ("MIN", "MAX"):
+        col = sorted_arg()
+        valid = col.valid_mask()
+        data = comparable_data(col)
+        if jnp.issubdtype(data.dtype, jnp.integer):
+            data = data.astype(jnp.int64)
+            sentinel = jnp.iinfo(jnp.int64).max if op == "MIN" else jnp.iinfo(jnp.int64).min
+        else:
+            data = data.astype(jnp.float64)
+            sentinel = jnp.inf if op == "MIN" else -jnp.inf
+        x = jnp.where(valid, data, sentinel)
+        combine = jnp.minimum if op == "MIN" else jnp.maximum
+        if lo_off is None and hi_off == 0:
+            out = segmented_scan(x, starts, combine)
+        elif lo_off is None and hi_off is None:
+            # whole partition: segment reduce then broadcast
+            total = segmented_scan(x, starts, combine)
+            out = total[seg_end]
+        elif lo_off is None:
+            # UNBOUNDED PRECEDING .. k: prefix scan + one gather (an O(n)
+            # shift loop here would build an O(n^2) trace)
+            fwd = segmented_scan(x, starts, combine)
+            out = fwd[jnp.clip(pos + hi_off, seg_start, seg_end)]
+        elif hi_off is None:
+            # k .. UNBOUNDED FOLLOWING: suffix scan + one gather
+            bwd = jnp.flip(segmented_scan(jnp.flip(x), ends_flags, combine))
+            out = bwd[jnp.clip(pos + lo_off, seg_start, seg_end)]
+        else:
+            # bounded frame: van Herk two-scan sliding window — O(n) for any
+            # frame width w. Width-w blocks get prefix/suffix scans; an
+            # UNCLIPPED frame [a, a+w-1] spans at most two blocks, so
+            # combine(blocksuffix[a], blockprefix[b]) covers it exactly.
+            # Frames clipped by a segment edge lose the alignment guarantee,
+            # so those rows select from plain segment scans instead.
+            w = max(hi_off - lo_off + 1, 1)
+            a_raw = pos + lo_off
+            b_raw = pos + hi_off
+            low_clip = a_raw < seg_start
+            high_clip = b_raw > seg_end
+            block_flags = (pos % w) == 0
+            fwd_vh = segmented_scan(x, starts | block_flags, combine)
+            rev_block = jnp.flip((pos % w) == (w - 1))
+            rev_block = rev_block.at[0].set(True)
+            bwd_vh = jnp.flip(segmented_scan(jnp.flip(x),
+                                             ends_flags | rev_block, combine))
+            fwd_seg = segmented_scan(x, starts, combine)
+            bwd_seg = jnp.flip(segmented_scan(jnp.flip(x), ends_flags,
+                                              combine))
+            a_s = jnp.clip(a_raw, 0, n - 1)
+            b_s = jnp.clip(b_raw, 0, n - 1)
+            vh = combine(bwd_vh[a_s], fwd_vh[b_s])
+            cum = fwd_seg[jnp.clip(b_raw, seg_start, seg_end)]
+            suf = bwd_seg[jnp.clip(a_raw, seg_start, seg_end)]
+            tot = fwd_seg[seg_end]
+            out = jnp.where(low_clip & high_clip, tot,
+                            jnp.where(low_clip, cum,
+                                      jnp.where(high_clip, suf, vh)))
+            in_frame_cnt = window_frame_sums(valid.astype(jnp.int64),
+                                             seg_start, seg_end, lo_off, hi_off)
+            m = in_frame_cnt > 0
+            if col.stype.is_string:
+                return _ranks_to_string(scatter_back(out, m), table.columns[arg_cols[0]], stype)
+            return scatter_back(out, m)
+        c = window_frame_sums(valid.astype(jnp.int64), seg_start, seg_end,
+                              lo_off, hi_off)
+        m = c > 0
+        if col.stype.is_string:
+            return _ranks_to_string(scatter_back(out, m),
+                                    table.columns[arg_cols[0]], stype)
+        return scatter_back(out, m)
+
+    if op == "SINGLE_VALUE":
+        col = sorted_arg()
+        src = seg_start
+        g = col.take(src)
+        out = scatter_back(g.data, g.mask)
+        if col.stype.is_string:
+            return Column(out.data.astype(jnp.int32), stype, out.mask, col.dictionary)
+        return out
+
+    raise NotImplementedError(f"Window function {op}")
+
+
+def _ranks_to_string(rank_col: Column, orig: Column, stype: SqlType) -> Column:
+    order = dict_sort_order(orig.dictionary)
+    inv = jnp.asarray(order.astype(np.int64))
+    safe = jnp.clip(rank_col.data.astype(jnp.int64), 0, len(order) - 1)
+    codes = jnp.take(inv, safe).astype(jnp.int32)
+    return Column(codes, stype, rank_col.mask, orig.dictionary)
+
+
+def _frame_offsets(op: str, frame, has_order: bool):
+    """Map a frame spec to (lo, hi) row offsets (None = unbounded)."""
+    if frame is None:
+        if has_order and op not in ("ROW_NUMBER", "RANK", "DENSE_RANK"):
+            return None, 0          # default: UNBOUNDED PRECEDING .. CURRENT
+        return None, None           # whole partition
+    kind, lo, hi = frame
+    def conv(b, default):
+        tag, n = b
+        if tag == "UNBOUNDED_PRECEDING":
+            return None
+        if tag == "UNBOUNDED_FOLLOWING":
+            return None
+        if tag == "CURRENT":
+            return 0
+        if tag == "PRECEDING":
+            return -int(n)
+        return int(n)
+    lo_v = conv(lo, None)
+    hi_v = conv(hi, 0)
+    if lo[0] == "UNBOUNDED_FOLLOWING":
+        lo_v = None
+    return lo_v, hi_v
+
+
+def _backward_fill_positions(pos, is_last, seg_end):
+    """For each row, position of the last row of its tie group."""
+    n = pos.shape[0]
+    # reverse scan: propagate next is_last position backwards
+    rev = jnp.flip(jnp.where(is_last, pos, -1))
+    rev_filled = jax.lax.associative_scan(
+        lambda a, b: jnp.where(b >= 0, b, a), rev)
+    # associative_scan is forward; combined op keeps latest valid
+    filled = jnp.flip(rev_filled)
+    return jnp.where(filled >= 0, filled, seg_end)

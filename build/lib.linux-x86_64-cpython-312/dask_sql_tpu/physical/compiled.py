@@ -64,6 +64,10 @@ _DENY_OPS = {"RAND", "RAND_INTEGER"}
 stats = {"compiles": 0, "hits": 0, "fallbacks": 0, "unsupported": 0,
          "recompiles": 0, "compile_errors": 0}
 
+# build-side payload channels (data + masks) above which the merge join's
+# extra sort operands cost more than the probe path's gathers (ADVICE r1)
+_MERGE_BUILD_WIDTH = int(os.environ.get("DSQL_MERGE_BUILD_WIDTH", "32"))
+
 
 class Unsupported(Exception):
     """Plan (or expression) outside the compilable subset."""
@@ -1273,14 +1277,28 @@ class _Tracer:
         bh = _hash_parts(bparts, bvalid)
 
         from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
+        # The merge join ships every build column (data + mask) as a sort
+        # payload channel; past a width cutoff the per-channel O(n log n)
+        # sort cost overtakes the probe path's per-column O(n) gathers even
+        # on TPU, so very wide build sides fall back to the gather strategy.
+        # (SEMI/ANTI carry no build columns, so the exist-test residual —
+        # which only the merge join supports — is never affected.)
+        build_width = 0
+        if jt in ("INNER", "LEFT", "RIGHT"):
+            build_width = sum(1 + (c.mask is not None)
+                              for c in build.table.columns)
         if _on_tpu():
-            # sorted-probe join: one 2-channel build-side argsort + binary
-            # search + row-id gathers, regardless of build width — so the
-            # r1/r2 wide-build strategy switch is gone (no per-column sort
-            # cost left for it to avoid)
-            match, gathered = self._join_merge(jt, probe, build, pparts,
-                                               bparts, pvalid, ph, bh,
-                                               exist_test)
+            if build_width <= _MERGE_BUILD_WIDTH:
+                match, gathered = self._join_merge(jt, probe, build, pparts,
+                                                   bparts, pvalid, ph, bh,
+                                                   exist_test)
+            else:
+                # wide build sides: per-channel sort cost overtakes gathers
+                # even on TPU (SEMI/ANTI are width 0, so exist_test — which
+                # the gather probe lacks — never lands here)
+                match, gathered = self._join_probe_gather(jt, probe, build,
+                                                          pparts, bparts,
+                                                          pvalid, ph, bh)
         else:
             # CPU/GPU: scatters and gathers cost ~1 ms where any 600k-row
             # sort costs 350-750 ms — hash-table join, no sort of either side
@@ -1390,67 +1408,85 @@ class _Tracer:
     def _join_merge(self, jt, probe: _VT, build: _VT, pparts, bparts,
                     pvalid: jax.Array, ph: jax.Array, bh: jax.Array,
                     exist_test=None):
-        """Sorted-probe join, the TPU strategy: sort ONLY the build side's
-        hashes (2-channel argsort at nb rows), binary-search each probe hash
-        (``searchsorted(method='scan')`` — a log2(nb)-step loop, so the HLO
-        is a few ops regardless of size), verify raw keys and fetch build
-        columns by row-id gathers.
+        """Merge join, index-carry formulation: ONE 3-channel stable sort of
+        the concatenated hash streams (hash, build-flag, position), a
+        2-channel associative "last build row index" carry scan, a 3-channel
+        unsort, then key verification and build-column fetch as row-id
+        GATHERS against the resident build arrays.
 
-        History: r1/r2 shipped a "zero-gather" merge join that moved every
-        build column through a variadic sort and an associative carry scan,
-        justified by an eager-mode profile (32 ms per gather at 1.8M rows).
-        That 32 ms was the per-op TUNNEL round trip, not the gather: inside
-        a compiled program a 6M-row gather costs ~1 ms on the same chip
-        (measured this round), while the payload formulation's compile time
-        explodes superlinearly on XLA:TPU at SF-1 shapes (13-channel sort
-        153 s; 2-channel associative_scan >15 min; whole two-join programs
-        >35 min — uncompilable in practice).  The sorted probe compiles in
-        seconds, sorts nb instead of nb+npr rows, and its gathers are noise.
-
-        SEMI/ANTI residual exist-tests still use the payload variant
-        (_join_merge_payload): per-run build aggregates need the sorted
-        x-value stream, and those plans carry no build columns, so their
-        channel count stays small.  Returns (match over probe rows, fetched
-        build columns or None for SEMI/ANTI)."""
+        The r1/r2 formulation shipped every build column + raw key through
+        the sort AND the carry scan as payload channels (cheaper per
+        operand at runtime: a random gather costs ~2x a sort operand,
+        32ms vs 7ms at 1.8M rows).  But XLA:TPU compile time explodes with
+        scan/sort operand count — measured on the tunneled v5e at 7.5M
+        rows: 13-channel sort 153s, 13-channel associative_scan >12min,
+        a full TPC-H Q3 program 200s at SF 0.05 and >35min at SF 1,
+        versus ~49s for a 3-channel sort.  With one query = one cached
+        program, steady state pays the gathers on every run but compile is
+        paid once — and an uncompilable program has no steady state at
+        all, so the scan carries ONE index channel and everything else
+        became gathers.  SEMI/ANTI residual exist-tests still use the
+        payload variant (build aggregates need per-run segmented scans,
+        and those plans carry no build columns, so their channel count is
+        already small).  Returns (match over probe rows, fetched build
+        columns or None for SEMI/ANTI)."""
         if exist_test is not None:
             return self._join_merge_payload(jt, probe, build, pparts,
                                             bparts, pvalid, ph, bh,
                                             exist_test)
         nb, npr = build.n, probe.n
-        if nb == 0:
-            # a gather from a 0-row build would fail at trace time; an
-            # empty build matches nothing (x NOT IN (empty) handled by the
-            # caller's null-aware logic over this all-false match)
-            self.fallback.append(jnp.zeros((), bool))
-            match = jnp.zeros(npr, dtype=bool)
-            if jt in ("SEMI", "ANTI"):
-                return match, None
-            # zero-filled columns, masked by the all-false match downstream
-            # (same values the payload formulation's concat-of-zeros carried)
-            return match, [
-                Column(jnp.zeros(npr, dtype=c0.data.dtype), c0.stype,
-                       None if c0.mask is None else jnp.zeros(npr, bool),
-                       c0.dictionary)
-                for c0 in build.table.columns]
-        order = jnp.argsort(bh)
-        bh_sorted = bh[order]
-        # duplicate build keys / hash collisions appear as adjacent equal
-        # hashes in sorted order (same flag policy as every strategy)
-        adj = (bh_sorted[1:] == bh_sorted[:-1]) & (bh_sorted[1:] != _U64_MAX)
-        raws_sorted = [braw[order] for _, braw in bparts]
-        self._append_join_flags(
-            jt, adj, [rs[1:] != rs[:-1] for rs in raws_sorted])
+        m = nb + npr
+        h_m = jnp.concatenate([bh, ph])
+        flag_b = jnp.concatenate([jnp.ones(nb, bool), jnp.zeros(npr, bool)])
+        idt = jnp.int32 if m < 2**31 else jnp.int64
+        iota_m = jnp.arange(m, dtype=idt)
 
-        pos = jnp.searchsorted(bh_sorted, ph, side="left", method="scan")
-        in_range = pos < nb
-        pos_c = jnp.minimum(pos, nb - 1)
-        cand = order[pos_c]
-        match = in_range & pvalid & (bh_sorted[pos_c] == ph)
-        for (_, praw), (_, braw) in zip(pparts, bparts):
-            match = match & (praw == braw[cand])
+        hs, fbs, iotas = jax.lax.sort((h_m, flag_b, iota_m),
+                                      num_keys=1, is_stable=True)
+
+        # equal-hash build rows are contiguous (stable sort puts build rows
+        # before same-hash probe rows), so duplicates/collisions show up as
+        # adjacent build pairs
+        adj = fbs[1:] & fbs[:-1] & (hs[1:] == hs[:-1]) & (hs[1:] != _U64_MAX)
+        if jt in ("SEMI", "ANTI"):
+            # collision detection compares adjacent raw keys in sorted
+            # order: a row-id gather against the resident build arrays
+            bi = jnp.clip(iotas, 0, nb - 1)  # build rows: position = row id
+            raw_diffs = []
+            for _, braw in bparts:
+                r_s = braw[bi]
+                raw_diffs.append(r_s[1:] != r_s[:-1])
+            self._append_join_flags(jt, adj, raw_diffs)
+        else:
+            self._append_join_flags(jt, adj, [])
+
+        # carry the LAST build row's index forward over the sorted stream
+        def carry_op(a, b):
+            take = b[0]
+            return (a[0] | b[0], jnp.where(take, b[1], a[1]))
+
+        has_b, c_idx = jax.lax.associative_scan(
+            carry_op, (fbs, jnp.where(fbs, iotas, 0)))
+
+        un = jax.lax.sort((iotas, has_b, c_idx), num_keys=1)
+        has_b_p = un[1][nb:]
+        j_p = jnp.clip(un[2][nb:], 0, nb - 1)
+
+        # a probe row matches iff the last build row at-or-before it has the
+        # same raw key (equal raw => equal hash, and everything between them
+        # in hash order then shares that hash); verify by gathering the
+        # build raws at the carried row id
+        match = has_b_p & pvalid
+        for (_, braw), (_, praw) in zip(bparts, pparts):
+            match = match & (braw[j_p] == praw)
+
         if jt in ("SEMI", "ANTI"):
             return match, None
-        return match, [c0.take(cand) for c0 in build.table.columns]
+        gathered = [Column(c0.data[j_p], c0.stype,
+                           None if c0.mask is None else c0.mask[j_p],
+                           c0.dictionary)
+                    for c0 in build.table.columns]
+        return match, gathered
 
     def _join_merge_payload(self, jt, probe: _VT, build: _VT, pparts,
                             bparts, pvalid: jax.Array, ph: jax.Array,
@@ -1714,6 +1750,30 @@ class _Tracer:
             return match, None
         return match, [c.take(cc) for c in build.table.columns]
 
+    def _join_probe_gather(self, jt, probe: _VT, build: _VT, pparts, bparts,
+                           pvalid: jax.Array, ph: jax.Array, bh: jax.Array):
+        """Classic sorted-hash probe: argsort the build hashes, binary-search
+        each probe hash (searchsorted sorts probe+build together under XLA),
+        then gather the candidate row for verification and output columns.
+        Preferred off-TPU, where random gathers are cheap."""
+        nb = build.n
+        order = jnp.argsort(bh)
+        bh_sorted = bh[order]
+        adj = (bh_sorted[1:] == bh_sorted[:-1]) & (bh_sorted[1:] != _U64_MAX)
+        raws_sorted = [raw[order] for _, raw in bparts]
+        self._append_join_flags(
+            jt, adj, [rs[1:] != rs[:-1] for rs in raws_sorted])
+
+        pos = jnp.searchsorted(bh_sorted, ph, side="left", method="sort")
+        in_range = pos < nb
+        pos_c = jnp.minimum(pos, nb - 1)
+        cand = order[pos_c]
+        match = in_range & pvalid & (bh_sorted[pos_c] == ph)
+        for (_, praw), (_, braw) in zip(pparts, bparts):
+            match = match & (praw == braw[cand])
+        if jt in ("SEMI", "ANTI"):
+            return match, None
+        return match, [c.take(cand) for c in build.table.columns]
 
 
 

@@ -1,0 +1,250 @@
+"""Custom statement handlers: DDL, SHOW/DESCRIBE/ANALYZE, and SQL-driven ML.
+
+Mirrors the reference's custom plugins
+(/root/reference/dask_sql/physical/rel/custom/): one handler per statement AST
+class, registered in a Pluggable dispatch — the same extension contract as the
+rel/rex registries.  Handlers receive (statement, context, sql_text) and
+return a device Table (for SHOW/ANALYZE/DESCRIBE metadata results) or None.
+"""
+from __future__ import annotations
+
+import logging
+import pickle
+from typing import Optional
+
+import numpy as np
+
+from ...datacontainer import TableEntry
+from ...sql import ast as A
+from ...table import Table
+from ...utils import Pluggable
+
+logger = logging.getLogger(__name__)
+
+
+class StatementDispatcher(Pluggable):
+    """Statement AST class name -> handler registry."""
+
+
+def _meta_table(data: dict) -> Table:
+    return Table.from_pydict(data)
+
+
+# ---------------------------------------------------------------------------
+# schema DDL (reference custom/create_schema.py, drop_schema.py, switch_schema.py)
+# ---------------------------------------------------------------------------
+
+def _create_schema(stmt: A.CreateSchema, context, sql):
+    if stmt.name in context.schema:
+        if stmt.if_not_exists:
+            return None
+        if not stmt.or_replace:
+            raise RuntimeError(f"A schema with the name {stmt.name} is already present.")
+    context.create_schema(stmt.name)
+    return None
+
+
+def _drop_schema(stmt: A.DropSchema, context, sql):
+    if stmt.name not in context.schema:
+        if stmt.if_exists:
+            return None
+        raise RuntimeError(f"A schema with the name {stmt.name} is not present.")
+    context.drop_schema(stmt.name)
+    return None
+
+
+def _use_schema(stmt: A.UseSchema, context, sql):
+    if stmt.name not in context.schema:
+        raise RuntimeError(f"A schema with the name {stmt.name} is not present.")
+    context.schema_name = stmt.name
+    return None
+
+
+# ---------------------------------------------------------------------------
+# table DDL (reference custom/create_table.py, create_table_as.py, drop_table.py)
+# ---------------------------------------------------------------------------
+
+def _create_table(stmt: A.CreateTable, context, sql):
+    schema_name, name = context.fqn(stmt.name)
+    if name in context.schema[schema_name].tables:
+        if stmt.if_not_exists:
+            return None
+        if not stmt.or_replace:
+            raise RuntimeError(f"A table with the name {name} is already present.")
+    kwargs = dict(stmt.kwargs)
+    try:
+        location = kwargs.pop("location")
+    except KeyError:
+        raise AttributeError("Parameters must include a 'location' parameter.")
+    fmt = kwargs.pop("format", None)
+    persist = bool(kwargs.pop("persist", False))
+    kwargs.pop("gpu", None)
+    context.create_table(name, location, format=fmt, persist=persist,
+                         schema_name=schema_name, **kwargs)
+    return None
+
+
+def _create_table_as(stmt: A.CreateTableAs, context, sql):
+    schema_name, name = context.fqn(stmt.name)
+    if name in context.schema[schema_name].tables:
+        if stmt.if_not_exists:
+            return None
+        if not stmt.or_replace:
+            raise RuntimeError(f"A table with the name {name} is already present.")
+    plan = context._get_plan(stmt.query, sql)
+    if stmt.view:
+        # views stay lazy: re-planned/executed per query (reference
+        # CREATE VIEW = lazy dask graph, create_table_as.py:30-55)
+        context.schema[schema_name].tables[name] = TableEntry(plan=plan)
+        return None
+    from .executor import RelExecutor
+    table = RelExecutor(context).execute(plan)
+    context.schema[schema_name].tables[name] = TableEntry(table=table)
+    return None
+
+
+def _drop_table(stmt: A.DropTable, context, sql):
+    schema_name, name = context.fqn(stmt.name)
+    if name not in context.schema[schema_name].tables:
+        if stmt.if_exists:
+            return None
+        raise RuntimeError(f"A table with the name {name} is not present.")
+    context.drop_table(name, schema_name=schema_name)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# SHOW / DESCRIBE / ANALYZE (reference custom/schemas.py, tables.py,
+# columns.py, show_models.py, describe_model.py, analyze.py)
+# ---------------------------------------------------------------------------
+
+def _show_schemas(stmt: A.ShowSchemas, context, sql):
+    names = list(context.schema.keys()) + ["information_schema"]
+    if stmt.like:
+        import re
+        from ..rex.ops import sql_like_to_regex
+        rx = re.compile(sql_like_to_regex(stmt.like))
+        names = [n for n in names if rx.match(n)]
+    return _meta_table({"Schema": np.array(names, dtype=object)})
+
+
+def _show_tables(stmt: A.ShowTables, context, sql):
+    schema_name = stmt.schema or context.schema_name
+    if schema_name not in context.schema:
+        raise AttributeError(f"Schema {schema_name} is not defined.")
+    names = list(context.schema[schema_name].tables.keys())
+    return _meta_table({"Table": np.array(names, dtype=object)})
+
+
+def _show_columns(stmt: A.ShowColumns, context, sql):
+    resolved = context.resolve_table(stmt.table)
+    if resolved is None:
+        raise AttributeError(f"Table {'.'.join(stmt.table)} is not defined.")
+    _, _, fields, _ = resolved
+    return _meta_table({
+        "Column": np.array([f.name for f in fields], dtype=object),
+        "Type": np.array([str(f.stype).lower() for f in fields], dtype=object),
+        "Extra": np.array([""] * len(fields), dtype=object),
+        "Comment": np.array([""] * len(fields), dtype=object),
+    })
+
+
+def _describe_table(stmt: A.DescribeTable, context, sql):
+    return _show_columns(A.ShowColumns(table=stmt.table), context, sql)
+
+
+def _show_models(stmt: A.ShowModels, context, sql):
+    names = list(context.schema[context.schema_name].models.keys())
+    return _meta_table({"Models": np.array(names, dtype=object)})
+
+
+def _describe_model(stmt: A.DescribeModel, context, sql):
+    info = context.resolve_model(stmt.name)
+    if info is None:
+        raise RuntimeError(f"A model with the name {'.'.join(stmt.name)} is not present.")
+    model, training_columns = info
+    params = model.get_params() if hasattr(model, "get_params") else {}
+    params["training_columns"] = list(training_columns)
+    keys = np.array(list(params.keys()), dtype=object)
+    vals = np.array([str(v) for v in params.values()], dtype=object)
+    return _meta_table({"Params": keys, "Value": vals})
+
+
+def _analyze_table(stmt: A.AnalyzeTable, context, sql):
+    """ANALYZE TABLE: describe()-style statistics (reference analyze.py:42-59)."""
+    resolved = context.resolve_table(stmt.table)
+    if resolved is None:
+        raise AttributeError(f"Table {'.'.join(stmt.table)} is not defined.")
+    schema_name, table_name, fields, _ = resolved
+    entry = context.schema[schema_name].tables[table_name]
+    from .executor import RelExecutor
+    table = entry.table if entry.table is not None else RelExecutor(context).execute(entry.plan)
+    columns = stmt.columns or table.names
+    df = table.limit_to(columns).to_pandas()
+    stats = df.describe(include="all")
+    import pandas as pd
+    extra = pd.DataFrame({c: [str(table.column(c).stype).lower()] for c in columns},
+                         index=["data_type"])
+    name_row = pd.DataFrame({c: [c] for c in columns}, index=["col_name"])
+    out = pd.concat([stats, extra, name_row])
+    out = out.reset_index().rename(columns={"index": "statistic"})
+    # stringify mixed-type statistic rows for a clean device table
+    for c in columns:
+        out[c] = out[c].astype(object).where(out[c].notna(), None)
+        out[c] = out[c].map(lambda v: str(v) if v is not None else None)
+    return Table.from_pandas(out)
+
+
+# ---------------------------------------------------------------------------
+# ML statements (reference custom/create_model.py, predict.py,
+# create_experiment.py, export_model.py, drop_model.py)
+# ---------------------------------------------------------------------------
+
+def _drop_model(stmt: A.DropModel, context, sql):
+    schema_name, name = context.fqn(stmt.name)
+    if name not in context.schema[schema_name].models:
+        if stmt.if_exists:
+            return None
+        raise RuntimeError(f"A model with the name {name} is not present.")
+    del context.schema[schema_name].models[name]
+    return None
+
+
+def _create_model(stmt: A.CreateModel, context, sql):
+    from ...models.training import create_model
+    return create_model(stmt, context, sql)
+
+
+def _create_experiment(stmt: A.CreateExperiment, context, sql):
+    from ...models.training import create_experiment
+    return create_experiment(stmt, context, sql)
+
+
+def _export_model(stmt: A.ExportModel, context, sql):
+    from ...models.training import export_model
+    return export_model(stmt, context, sql)
+
+
+def _explain(stmt: A.ExplainStatement, context, sql):
+    text = context._get_plan(stmt.query, sql).explain()
+    return _meta_table({"PLAN": np.array(text.splitlines(), dtype=object)})
+
+
+StatementDispatcher.add_plugin("CreateSchema", _create_schema)
+StatementDispatcher.add_plugin("DropSchema", _drop_schema)
+StatementDispatcher.add_plugin("UseSchema", _use_schema)
+StatementDispatcher.add_plugin("CreateTable", _create_table)
+StatementDispatcher.add_plugin("CreateTableAs", _create_table_as)
+StatementDispatcher.add_plugin("DropTable", _drop_table)
+StatementDispatcher.add_plugin("ShowSchemas", _show_schemas)
+StatementDispatcher.add_plugin("ShowTables", _show_tables)
+StatementDispatcher.add_plugin("ShowColumns", _show_columns)
+StatementDispatcher.add_plugin("DescribeTable", _describe_table)
+StatementDispatcher.add_plugin("ShowModels", _show_models)
+StatementDispatcher.add_plugin("DescribeModel", _describe_model)
+StatementDispatcher.add_plugin("AnalyzeTable", _analyze_table)
+StatementDispatcher.add_plugin("DropModel", _drop_model)
+StatementDispatcher.add_plugin("CreateModel", _create_model)
+StatementDispatcher.add_plugin("CreateExperiment", _create_experiment)
+StatementDispatcher.add_plugin("ExportModel", _export_model)
+StatementDispatcher.add_plugin("ExplainStatement", _explain)

@@ -1,0 +1,1063 @@
+"""Scalar operation library: REX op name -> device kernel.
+
+TPU-native re-implementation of the reference's ~70-operator mapping
+(/root/reference/dask_sql/physical/rex/core/call.py:685-762): logic and
+comparisons with three-valued NULL semantics, SQL truncating division
+(call.py:120-144), CASE (147), CAST (183), IS [NOT] TRUE/FALSE/NULL/DISTINCT
+(206-284), LIKE/SIMILAR-to-regex transpilation (287-385), POSITION/SUBSTRING/
+TRIM/OVERLAY (388-473), EXTRACT's datetime fields (474-513), datetime-aware
+CEIL/FLOOR (516), seeded RAND (558-639), plus the math/string function set.
+
+Value model: every op takes a list of Column/Scalar args plus the
+binder-inferred result type and returns Column or Scalar.  Numeric work runs
+on device via jnp; string work runs on the (small) host dictionary with a
+device gather to map results back to rows.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Callable, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.kernels import (
+    US_PER_DAY, civil_from_days, days_from_civil, extract_field,
+    timestamp_time_of_day_us, timestamp_to_days, trunc_date,
+    unify_string_codes,
+)
+from ...table import Column, Scalar
+from ...types import (
+    BOOLEAN, DOUBLE, SqlType, VARCHAR, physical_dtype,
+    python_value_to_physical,
+)
+
+Value = Union[Column, Scalar]
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def is_string_value(v: Value) -> bool:
+    return v.stype.is_string or (isinstance(v, Scalar) and isinstance(v.value, str))
+
+
+def combine_masks(*vals: Value) -> Optional[jax.Array]:
+    mask = None
+    for v in vals:
+        if isinstance(v, Column) and v.mask is not None:
+            mask = v.mask if mask is None else (mask & v.mask)
+    return mask
+
+
+def all_null_column(length: int, stype: SqlType) -> Column:
+    return Column.from_scalar(Scalar(None, stype), length)
+
+
+def _data(v: Value):
+    """jnp array or python scalar for numeric computation."""
+    if isinstance(v, Column):
+        return v.data
+    return v.value
+
+
+def _length(args: List[Value]) -> Optional[int]:
+    for a in args:
+        if isinstance(a, Column):
+            return len(a)
+    return None
+
+
+def _any_null_scalar(args: List[Value]) -> bool:
+    return any(isinstance(a, Scalar) and a.is_null for a in args)
+
+
+def _decode_value(v: Value, n: int) -> np.ndarray:
+    """Host object array of strings/None for any value."""
+    if isinstance(v, Column):
+        if v.stype.is_string:
+            return v.decode()
+        return v.to_numpy().astype(object)
+    return np.array([v.value] * n, dtype=object)
+
+
+def encode_strings(values: np.ndarray, mask: Optional[np.ndarray] = None) -> Column:
+    return Column._encode_strings(values, mask)
+
+
+# ---------------------------------------------------------------------------
+# elementwise numeric ops
+# ---------------------------------------------------------------------------
+
+def numeric_op(fn: Callable, py_fn: Optional[Callable] = None,
+               cast_to_result: bool = True):
+    """Lift a jnp elementwise function into the Column/Scalar value model
+    with NULL propagation."""
+
+    def op(args: List[Value], stype: SqlType, ctx) -> Value:
+        n = _length(args)
+        if _any_null_scalar(args):
+            if n is None:
+                return Scalar(None, stype)
+            return all_null_column(n, stype)
+        if n is None:
+            vals = [a.value for a in args]
+            out = (py_fn or fn)(*vals)
+            if stype.is_integer and out is not None and not isinstance(out, bool):
+                out = int(out)
+            return Scalar(out, stype)
+        data = [_data(a) for a in args]
+        out = fn(*data)
+        if cast_to_result and not stype.is_string:
+            out = out.astype(physical_dtype(stype))
+        return Column(out, stype, combine_masks(*args))
+
+    return op
+
+
+def sql_div(a, b):
+    """SQL division: truncates toward zero for integers (reference
+    SQLDivisionOperator, call.py:120-144)."""
+    if jnp.issubdtype(jnp.result_type(a, b), jnp.integer):
+        q = jnp.floor_divide(jnp.abs(a), jnp.abs(b))
+        return (jnp.sign(a) * jnp.sign(b) * q).astype(jnp.result_type(a, b))
+    return a / b
+
+
+def _py_div(a, b):
+    if isinstance(a, int) and isinstance(b, int):
+        return int(a / b) if b != 0 else None
+    if b == 0:
+        # match the COLUMN path's IEEE semantics (jnp a/0.0 -> ±inf, 0/0 ->
+        # nan; the reference's pandas substrate does the same) instead of
+        # raising ZeroDivisionError on the scalar-literal path
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return float(np.float64(a) / np.float64(b))
+    return a / b
+
+
+# ---------------------------------------------------------------------------
+# temporal arithmetic
+# ---------------------------------------------------------------------------
+
+def add_months(days: jax.Array, months) -> jax.Array:
+    y, m, d = civil_from_days(days)
+    total = (y * 12 + (m - 1)) + months
+    ny = jnp.floor_divide(total, 12)
+    nm = total - ny * 12 + 1
+    # clamp day to month length
+    nm_next = jnp.where(nm == 12, 1, nm + 1)
+    ny_next = jnp.where(nm == 12, ny + 1, ny)
+    month_len = days_from_civil(ny_next, nm_next, jnp.ones_like(d)) - days_from_civil(
+        ny, nm, jnp.ones_like(d))
+    nd = jnp.minimum(d, month_len)
+    return days_from_civil(ny, nm, nd)
+
+
+def temporal_plus_minus(sign: int):
+    def op(args: List[Value], stype: SqlType, ctx) -> Value:
+        a, b = args
+        n = _length(args)
+        if _any_null_scalar(args):
+            return all_null_column(n, stype) if n is not None else Scalar(None, stype)
+        at, bt = a.stype, b.stype
+        mask = combine_masks(a, b)
+        # temporal - temporal -> interval ms
+        if at.is_temporal and bt.is_temporal:
+            av = _to_us(a)
+            bv = _to_us(b)
+            out = (av - bv) // 1000
+            return _wrap(out, stype, mask, n)
+        if at.is_interval and bt.is_temporal:
+            a, b = b, a
+            at, bt = bt, at
+        if at.is_temporal and bt.is_interval:
+            if bt.name == "INTERVAL_YEAR_MONTH":
+                months = _data(b) * sign
+                if at.name == "DATE":
+                    out = add_months(_as_array(_data(a), n), months)
+                else:
+                    us = _as_array(_data(a), n)
+                    days = timestamp_to_days(us)
+                    tod = timestamp_time_of_day_us(us)
+                    out = add_months(days, months) * US_PER_DAY + tod
+                return _wrap(out, stype, mask, n)
+            ms = _data(b) * sign
+            if at.name == "DATE" and stype.name == "DATE":
+                out = _as_array(_data(a), n).astype(jnp.int64) + ms // 86_400_000
+            elif at.name == "DATE":
+                out = _as_array(_data(a), n).astype(jnp.int64) * US_PER_DAY + ms * 1000
+            else:
+                out = _as_array(_data(a), n) + ms * 1000
+            return _wrap(out, stype, mask, n)
+        if at.is_interval and bt.is_interval:
+            out = _data(a) + sign * _data(b)
+            return _wrap(out, stype, mask, n)
+        # plain numeric
+        out = _data(a) + sign * _data(b)
+        return _wrap(out, stype, mask, n)
+
+    return op
+
+
+def _to_us(v: Value):
+    if v.stype.name == "DATE":
+        return _data(v) * US_PER_DAY if isinstance(v, Scalar) else v.data.astype(jnp.int64) * US_PER_DAY
+    return _data(v)
+
+
+def _as_array(x, n):
+    if isinstance(x, jax.Array) and x.ndim > 0:
+        return x
+    return jnp.full(n or 1, x)
+
+
+def _wrap(out, stype, mask, n) -> Value:
+    if isinstance(out, jax.Array) and out.ndim > 0:
+        return Column(out.astype(physical_dtype(stype)), stype, mask)
+    return Scalar(python_value_to_physical(out, stype) if not isinstance(out, (int, float, bool)) else out, stype)
+
+
+# ---------------------------------------------------------------------------
+# comparisons (string-aware)
+# ---------------------------------------------------------------------------
+
+_CMP_FNS = {
+    "=": (lambda a, b: a == b),
+    "<>": (lambda a, b: a != b),
+    "<": (lambda a, b: a < b),
+    "<=": (lambda a, b: a <= b),
+    ">": (lambda a, b: a > b),
+    ">=": (lambda a, b: a >= b),
+}
+
+
+def comparison(op_name: str):
+    fn = _CMP_FNS[op_name]
+
+    def op(args: List[Value], stype: SqlType, ctx) -> Value:
+        a, b = args
+        n = _length(args)
+        if _any_null_scalar(args):
+            return all_null_column(n, BOOLEAN) if n is not None else Scalar(None, BOOLEAN)
+        if is_string_value(a) or is_string_value(b):
+            return _string_compare(fn, a, b, n)
+        da, db = _data(a), _data(b)
+        # temporal mixed units
+        if a.stype.name == "DATE" and b.stype.name in ("TIMESTAMP", "TIMESTAMP_WITH_LOCAL_TIME_ZONE"):
+            da = da * US_PER_DAY if not isinstance(da, jax.Array) else da.astype(jnp.int64) * US_PER_DAY
+        if b.stype.name == "DATE" and a.stype.name in ("TIMESTAMP", "TIMESTAMP_WITH_LOCAL_TIME_ZONE"):
+            db = db * US_PER_DAY if not isinstance(db, jax.Array) else db.astype(jnp.int64) * US_PER_DAY
+        if n is None:
+            return Scalar(bool(fn(da, db)), BOOLEAN)
+        out = fn(da, db)
+        return Column(out, BOOLEAN, combine_masks(a, b))
+
+    return op
+
+
+def _string_compare(fn, a: Value, b: Value, n: Optional[int]) -> Value:
+    if n is None:
+        return Scalar(bool(fn(a.value, b.value)), BOOLEAN)
+    if isinstance(a, Column) and isinstance(b, Column) and a.stype.is_string and b.stype.is_string:
+        ca, cb = unify_string_codes([a, b])
+        return Column(fn(ca, cb), BOOLEAN, combine_masks(a, b))
+    # column vs scalar
+    if isinstance(a, Scalar):
+        a, b = b, a
+        flip = {jnp.less: jnp.greater}  # not used; use swapped comparison below
+        # re-derive fn with swapped args
+        fn_orig = fn
+        fn = lambda x, y: fn_orig(y, x)  # noqa: E731
+    col, scal = a, b
+    if col.stype.is_string:
+        d = col.dictionary.astype(str)
+        per_dict = fn(d, str(scal.value))
+        out = jnp.take(jnp.asarray(per_dict),
+                       jnp.clip(col.data, 0, len(d) - 1))
+        return Column(out, BOOLEAN, col.mask)
+    # numeric column vs string scalar: cast scalar
+    try:
+        v = float(scal.value)
+    except (TypeError, ValueError):
+        return Column(jnp.zeros(len(col), bool), BOOLEAN, col.mask)
+    return Column(fn(col.data, v), BOOLEAN, col.mask)
+
+
+# ---------------------------------------------------------------------------
+# boolean logic: three-valued AND/OR/NOT
+# ---------------------------------------------------------------------------
+
+def _to_bool_parts(v: Value, n: int):
+    """Returns (value_array, known_array) for Kleene logic."""
+    if isinstance(v, Scalar):
+        if v.is_null:
+            return jnp.zeros(n, bool), jnp.zeros(n, bool)
+        return jnp.full(n, bool(v.value)), jnp.ones(n, bool)
+    data = v.data.astype(bool)
+    known = v.valid_mask()
+    return data & known, known
+
+
+def logical_and(args, stype, ctx):
+    n = _length(args)
+    if n is None:
+        vals = [a.value for a in args]
+        if any(v is False for v in vals):
+            return Scalar(False, BOOLEAN)
+        if any(v is None for v in vals):
+            return Scalar(None, BOOLEAN)
+        return Scalar(True, BOOLEAN)
+    va, ka = _to_bool_parts(args[0], n)
+    vb, kb = _to_bool_parts(args[1], n)
+    out = va & vb
+    # known if: both known, or either is a known False
+    known = (ka & kb) | (ka & ~va) | (kb & ~vb)
+    mask = known
+    return Column(out, BOOLEAN, mask)
+
+
+def logical_or(args, stype, ctx):
+    n = _length(args)
+    if n is None:
+        vals = [a.value for a in args]
+        if any(v is True for v in vals):
+            return Scalar(True, BOOLEAN)
+        if any(v is None for v in vals):
+            return Scalar(None, BOOLEAN)
+        return Scalar(False, BOOLEAN)
+    va, ka = _to_bool_parts(args[0], n)
+    vb, kb = _to_bool_parts(args[1], n)
+    out = va | vb
+    known = (ka & kb) | (ka & va) | (kb & vb)
+    mask = known
+    return Column(out, BOOLEAN, mask)
+
+
+def logical_not(args, stype, ctx):
+    (a,) = args
+    if isinstance(a, Scalar):
+        return Scalar(None if a.is_null else (not bool(a.value)), BOOLEAN)
+    return Column(~a.data.astype(bool), BOOLEAN, a.mask)
+
+
+# ---------------------------------------------------------------------------
+# IS ... predicates (never null)
+# ---------------------------------------------------------------------------
+
+def is_null(args, stype, ctx):
+    (a,) = args
+    if isinstance(a, Scalar):
+        return Scalar(a.is_null, BOOLEAN)
+    return Column(~a.valid_mask(), BOOLEAN, None)
+
+
+def is_not_null(args, stype, ctx):
+    (a,) = args
+    if isinstance(a, Scalar):
+        return Scalar(not a.is_null, BOOLEAN)
+    return Column(a.valid_mask(), BOOLEAN, None)
+
+
+def _is_bool(value: bool, negated: bool):
+    def op(args, stype, ctx):
+        (a,) = args
+        if isinstance(a, Scalar):
+            r = (not a.is_null) and bool(a.value) == value
+            return Scalar((not r) if negated else r, BOOLEAN)
+        r = a.valid_mask() & (a.data.astype(bool) == value)
+        if negated:
+            r = ~r
+        return Column(r, BOOLEAN, None)
+
+    return op
+
+
+def is_distinct_from(negated: bool):
+    def op(args, stype, ctx):
+        a, b = args
+        n = _length(args)
+        if n is None:
+            an, bn = a.is_null, b.is_null
+            if an or bn:
+                distinct = an != bn
+            else:
+                distinct = a.value != b.value
+            return Scalar((not distinct) if negated else distinct, BOOLEAN)
+        eq = comparison("=")( [a, b], BOOLEAN, ctx)
+        ev, ek = _to_bool_parts(eq if isinstance(eq, Column) else Column.from_scalar(eq, n), n)
+        a_null = ~a.valid_mask() if isinstance(a, Column) else jnp.full(n, a.is_null)
+        b_null = ~b.valid_mask() if isinstance(b, Column) else jnp.full(n, b.is_null)
+        both_null = a_null & b_null
+        either_null = a_null | b_null
+        distinct = jnp.where(either_null, ~both_null, ~(ev & ek))
+        if negated:
+            distinct = ~distinct
+        return Column(distinct, BOOLEAN, None)
+
+    return op
+
+
+# ---------------------------------------------------------------------------
+# CASE / COALESCE / NULLIF / GREATEST / LEAST
+# ---------------------------------------------------------------------------
+
+def _cast_value_to(v: Value, stype: SqlType, n: Optional[int]) -> Value:
+    from .cast import cast_value  # local import to avoid cycle
+    return cast_value(v, stype, n)
+
+
+def case_op(args: List[Value], stype: SqlType, ctx) -> Value:
+    n = _length(args)
+    *pairs, else_v = args
+    if n is None:
+        for i in range(0, len(pairs), 2):
+            c = pairs[i]
+            if not c.is_null and bool(c.value):
+                return _cast_value_to(pairs[i + 1], stype, None)
+        return _cast_value_to(else_v, stype, None)
+    else_c = _as_col(_cast_value_to(else_v, stype, n), n, stype)
+    out_data = else_c.data
+    out_valid = else_c.valid_mask()
+    taken = jnp.zeros(n, bool)
+    for i in range(0, len(pairs), 2):
+        cond = pairs[i]
+        val = _as_col(_cast_value_to(pairs[i + 1], stype, n), n, stype)
+        cv, ck = _to_bool_parts(cond if isinstance(cond, Column) else Column.from_scalar(cond, n), n)
+        sel = cv & ck & ~taken
+        out_data = jnp.where(sel, val.data, out_data)
+        out_valid = jnp.where(sel, val.valid_mask(), out_valid)
+        taken = taken | sel
+    mask = out_valid
+    dictionary = else_c.dictionary
+    if stype.is_string:
+        # string CASE: fall back to host path for dictionary merge
+        vals = np.where(np.asarray(taken), "", "")  # placeholder
+        return _string_case(pairs, else_v, n, stype)
+    return Column(out_data, stype, mask)
+
+
+def _string_case(pairs, else_v, n, stype):
+    sel_done = np.zeros(n, bool)
+    out = np.array([None] * n, dtype=object)
+    for i in range(0, len(pairs), 2):
+        cond, val = pairs[i], pairs[i + 1]
+        cv, ck = _to_bool_parts(cond if isinstance(cond, Column) else Column.from_scalar(cond, n), n)
+        sel = np.asarray(cv & ck) & ~sel_done
+        vals = _decode_value(val, n)
+        out[sel] = vals[sel]
+        sel_done |= sel
+    ev = _decode_value(else_v, n)
+    out[~sel_done] = ev[~sel_done]
+    mask = np.array([o is not None for o in out])
+    return encode_strings(np.where(mask, out, ""), mask if not mask.all() else None)
+
+
+def coalesce_op(args: List[Value], stype: SqlType, ctx) -> Value:
+    n = _length(args)
+    if n is None:
+        for a in args:
+            if not a.is_null:
+                return _cast_value_to(a, stype, None)
+        return Scalar(None, stype)
+    if stype.is_string:
+        out = np.array([None] * n, dtype=object)
+        filled = np.zeros(n, bool)
+        for a in args:
+            vals = _decode_value(a, n)
+            avail = np.array([v is not None for v in vals]) & ~filled
+            out[avail] = vals[avail]
+            filled |= avail
+        mask = filled
+        return encode_strings(np.where(mask, out, ""), mask if not mask.all() else None)
+    cols = [_as_col(_cast_value_to(a, stype, n), n, stype) for a in args]
+    out = cols[0].data
+    valid = cols[0].valid_mask()
+    for c in cols[1:]:
+        out = jnp.where(valid, out, c.data)
+        valid = valid | c.valid_mask()
+    return Column(out, stype, valid)
+
+
+def nullif_op(args, stype, ctx):
+    a, b = args
+    n = _length(args)
+    eq = comparison("=")([a, b], BOOLEAN, ctx)
+    if n is None:
+        if not eq.is_null and eq.value:
+            return Scalar(None, stype)
+        return a
+    ac = _as_col(a, n, stype)
+    ev, ek = _to_bool_parts(eq if isinstance(eq, Column) else Column.from_scalar(eq, n), n)
+    new_mask = ac.valid_mask() & ~(ev & ek)
+    return ac.with_mask(new_mask)
+
+
+def greatest_least(is_greatest: bool):
+    def op(args, stype, ctx):
+        n = _length(args)
+        # SQL GREATEST returns NULL if any argument is NULL (Calcite) — but
+        # postgres skips nulls; Calcite semantics: null if any null.
+        if n is None:
+            vals = [a.value for a in args]
+            if any(v is None for v in vals):
+                return Scalar(None, stype)
+            return Scalar(max(vals) if is_greatest else min(vals), stype)
+        cols = [_as_col(_cast_value_to(a, stype, n), n, stype) for a in args]
+        out = cols[0].data
+        for c in cols[1:]:
+            out = jnp.maximum(out, c.data) if is_greatest else jnp.minimum(out, c.data)
+        return Column(out, stype, combine_masks(*cols))
+
+    return op
+
+
+def _as_col(v: Value, n: int, stype: SqlType = None) -> Column:
+    if isinstance(v, Column):
+        return v
+    return Column.from_scalar(v, n)
+
+
+# ---------------------------------------------------------------------------
+# IN list
+# ---------------------------------------------------------------------------
+
+def in_list_op(args: List[Value], stype: SqlType, ctx) -> Value:
+    expr, *values = args
+    n = _length([expr])
+    out = None
+    for v in values:
+        eq = comparison("=")([expr, v], BOOLEAN, ctx)
+        out = eq if out is None else logical_or([out, eq], BOOLEAN, ctx)
+    if out is None:
+        return Scalar(False, BOOLEAN)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# LIKE / SIMILAR / regex  (reference transpiler: call.py:287-385)
+# ---------------------------------------------------------------------------
+
+def sql_like_to_regex(pattern: str, escape: Optional[str] = None) -> str:
+    out = []
+    i = 0
+    esc = escape
+    while i < len(pattern):
+        c = pattern[i]
+        if esc and c == esc and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+def sql_similar_to_regex(pattern: str, escape: Optional[str] = None) -> str:
+    """SIMILAR TO: SQL regex flavor — % and _ wildcards plus POSIX-ish groups."""
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if escape and c == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(c)  # pass through regex metacharacters
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+def like_op(kind: str):
+    def op(args: List[Value], stype: SqlType, ctx) -> Value:
+        expr, pattern, *rest = args
+        escape = rest[0].value if rest else None
+        if isinstance(pattern, Column):
+            # per-row patterns: host path
+            n = len(pattern)
+            vals = _decode_value(expr, n)
+            pats = _decode_value(pattern, n)
+            out = np.zeros(n, bool)
+            mask = np.ones(n, bool)
+            for i, (v, p) in enumerate(zip(vals, pats)):
+                if v is None or p is None:
+                    mask[i] = False
+                    continue
+                rx = sql_like_to_regex(p, escape) if kind != "SIMILAR" else sql_similar_to_regex(p, escape)
+                flags = re.IGNORECASE if kind == "ILIKE" else 0
+                out[i] = re.match(rx, str(v), flags) is not None
+            return Column(jnp.asarray(out), BOOLEAN,
+                          None if mask.all() else jnp.asarray(mask))
+        if pattern.is_null or (isinstance(expr, Scalar) and expr.is_null):
+            n = _length(args)
+            return all_null_column(n, BOOLEAN) if n is not None else Scalar(None, BOOLEAN)
+        pat = str(pattern.value)
+
+        def _regex_bitmap(d):
+            rx = (sql_similar_to_regex(pat, escape) if kind == "SIMILAR"
+                  else sql_like_to_regex(pat, escape))
+            flags = re.IGNORECASE if kind == "ILIKE" else 0
+            compiled = re.compile(rx, flags)
+            return np.array([compiled.match(s) is not None for s in d])
+
+        if isinstance(expr, Scalar):
+            return Scalar(bool(_regex_bitmap([str(expr.value)])[0]), BOOLEAN)
+        from ...ops.strings_fast import (DEVICE_STRING_THRESHOLD,
+                                         device_like_bitmap, dict_as_str,
+                                         like_bitmap_vectorized)
+        if expr.stype.is_string:
+            dct = expr.dictionary
+            if len(dct) >= DEVICE_STRING_THRESHOLD:
+                # past the dictionary cliff: chunk matching runs on device
+                # over the memoized bytes matrix.  Under the whole-plan
+                # tracer this executes EAGERLY (dct is concrete) and the
+                # resulting D-bool bitmap bakes into the program as a
+                # constant — sound because the program cache is keyed on
+                # dictionary content, and D bools are tiny next to the
+                # bytes matrix itself
+                per_dev = device_like_bitmap(dct, pat, escape, kind)
+                if per_dev is not None:
+                    from ...ops import strings_fast as _sf
+                    _sf.stats["device_bitmaps"] += 1
+                    out = jnp.take(per_dev,
+                                   jnp.clip(expr.data, 0, len(dct) - 1))
+                    return Column(out, BOOLEAN, expr.mask)
+            d = dict_as_str(dct)
+            per = like_bitmap_vectorized(d, pat, escape, kind)
+            if per is None:
+                per = _regex_bitmap(d)
+            out = jnp.take(jnp.asarray(per), jnp.clip(expr.data, 0, len(d) - 1))
+            return Column(out, BOOLEAN, expr.mask)
+        d = expr.to_numpy().astype(str)
+        per = like_bitmap_vectorized(d, pat, escape, kind)
+        if per is None:
+            per = _regex_bitmap(d)
+        return Column(jnp.asarray(per), BOOLEAN, expr.mask)
+
+    return op
+
+
+# ---------------------------------------------------------------------------
+# string functions (dictionary-path)
+# ---------------------------------------------------------------------------
+
+def map_dictionary(col: Column, fn: Callable[[np.ndarray], np.ndarray],
+                   stype: SqlType) -> Column:
+    """Apply fn over the dictionary, map back to rows via device gather."""
+    d = col.dictionary.astype(str)
+    res = fn(d)
+    if stype.is_string:
+        res = np.asarray(res, dtype=object)
+        newdict, newcodes = np.unique(res.astype(str), return_inverse=True)
+        codes = jnp.take(jnp.asarray(newcodes.astype(np.int32)),
+                         jnp.clip(col.data, 0, len(d) - 1))
+        return Column(codes, VARCHAR, col.mask, newdict.astype(object))
+    arr = np.asarray(res)
+    out = jnp.take(jnp.asarray(arr.astype(physical_dtype(stype))),
+                   jnp.clip(col.data, 0, len(d) - 1))
+    return Column(out, stype, col.mask)
+
+
+def string_unary(fn_one: Callable[[str], object]):
+    """Lift a python str->value function into the value model."""
+
+    def op(args: List[Value], stype: SqlType, ctx) -> Value:
+        (a,) = args
+        if isinstance(a, Scalar):
+            if a.is_null:
+                return Scalar(None, stype)
+            return Scalar(fn_one(str(a.value)), stype)
+        return map_dictionary(a, lambda d: np.array([fn_one(s) for s in d], dtype=object),
+                              stype)
+
+    return op
+
+
+def string_nary(fn_row: Callable[..., object]):
+    """N-ary string function; scalar extra args ride along; any column
+    combination falls back to the host path (rare)."""
+
+    def op(args: List[Value], stype: SqlType, ctx) -> Value:
+        n = _length(args)
+        if _any_null_scalar(args):
+            return all_null_column(n, stype) if n is not None else Scalar(None, stype)
+        if n is None:
+            return Scalar(fn_row(*[a.value for a in args]), stype)
+        str_cols = [a for a in args if isinstance(a, Column) and a.stype.is_string]
+        non_str_cols = [a for a in args if isinstance(a, Column) and not a.stype.is_string]
+        if len(str_cols) == 1 and not non_str_cols:
+            col = str_cols[0]
+            fixed = [a.value if isinstance(a, Scalar) else None for a in args]
+            pos = [i for i, a in enumerate(args) if isinstance(a, Column)][0]
+
+            def apply_dict(d):
+                out = []
+                for s in d:
+                    row = list(fixed)
+                    row[pos] = s
+                    out.append(fn_row(*row))
+                return np.array(out, dtype=object)
+
+            return map_dictionary(col, apply_dict, stype)
+        # general host path
+        host = [_decode_value(a, n) for a in args]
+        out = []
+        mask = np.ones(n, bool)
+        for i in range(n):
+            row = [h[i] for h in host]
+            if any(v is None for v in row):
+                mask[i] = False
+                out.append(None)
+            else:
+                out.append(fn_row(*row))
+        if stype.is_string:
+            return encode_strings(
+                np.array([o if o is not None else "" for o in out], dtype=object),
+                mask if not mask.all() else None)
+        arr = np.array([o if o is not None else 0 for o in out])
+        return Column(jnp.asarray(arr.astype(physical_dtype(stype))), stype,
+                      None if mask.all() else jnp.asarray(mask))
+
+    return op
+
+
+def _substring(s, start, length=None):
+    start = int(start)
+    begin = max(start - 1, 0) if start > 0 else max(len(s) + start, 0) if start < 0 else 0
+    if start <= 0:
+        # SQL: position counts from 1; nonpositive start shifts window
+        begin = 0
+        if length is not None:
+            length = length + (start - 1)
+            if length <= 0:
+                return ""
+    if length is None:
+        return s[begin:]
+    return s[begin : begin + max(int(length), 0)]
+
+
+def _trim(side, chars, s):
+    chars = chars or " "
+    if side == "LEADING":
+        return s.lstrip(chars)
+    if side == "TRAILING":
+        return s.rstrip(chars)
+    return s.strip(chars)
+
+
+def _overlay(s, repl, start, length=None):
+    start = int(start)
+    if length is None:
+        length = len(repl)
+    return s[: start - 1] + repl + s[start - 1 + int(length):]
+
+
+def _split_part(s, delim, idx):
+    parts = s.split(delim)
+    i = int(idx)
+    if 1 <= i <= len(parts):
+        return parts[i - 1]
+    return ""
+
+
+def concat_op(args: List[Value], stype: SqlType, ctx) -> Value:
+    # || : NULL-propagating two-arg concat; CONCAT() ignores nulls in some
+    # dialects but Calcite CONCAT propagates — keep propagation.
+    def fn(*vals):
+        return "".join(str(v) for v in vals)
+    return string_nary(fn)(args, stype, ctx)
+
+
+# ---------------------------------------------------------------------------
+# EXTRACT / datetime ops
+# ---------------------------------------------------------------------------
+
+def extract_op(args: List[Value], stype: SqlType, ctx) -> Value:
+    field_v, src = args
+    field = str(field_v.value)
+    n = _length([src])
+    if isinstance(src, Scalar):
+        if src.is_null:
+            return Scalar(None, stype)
+        arr = jnp.asarray([src.value])
+        col = Column(arr, src.stype)
+        res = extract_op([field_v, col], stype, ctx)
+        return Scalar(int(np.asarray(res.data)[0]), stype)
+    if src.stype.name == "DATE":
+        days = src.data.astype(jnp.int64)
+        tod = None
+    elif src.stype.is_temporal:
+        days = timestamp_to_days(src.data)
+        tod = timestamp_time_of_day_us(src.data)
+    elif src.stype.is_interval:
+        ms = src.data
+        out = {"DAY": ms // 86_400_000, "HOUR": (ms // 3_600_000) % 24,
+               "MINUTE": (ms // 60_000) % 60, "SECOND": (ms // 1000) % 60,
+               "EPOCH": ms // 1000}.get(field.upper())
+        if out is None:
+            raise NotImplementedError(f"EXTRACT {field} from interval")
+        return Column(out.astype(jnp.int64), stype, src.mask)
+    else:
+        raise TypeError(f"EXTRACT from {src.stype}")
+    out = extract_field(field, days, tod)
+    return Column(out.astype(jnp.int64), stype, src.mask)
+
+
+def floor_ceil_op(is_floor: bool):
+    def op(args: List[Value], stype: SqlType, ctx) -> Value:
+        if len(args) == 2 and isinstance(args[1], Scalar) and args[1].stype.name == "SYMBOL":
+            src, unit = args[0], str(args[1].value)
+            n = _length([src])
+            if isinstance(src, Scalar):
+                if src.is_null:
+                    return Scalar(None, stype)
+                col = Column(jnp.asarray([src.value]), src.stype)
+                r = op([col, args[1]], stype, ctx)
+                return Scalar(int(np.asarray(r.data)[0]), stype)
+            if src.stype.name == "DATE":
+                days, _ = trunc_date(unit, src.data.astype(jnp.int64), None)
+                out = days
+                if not is_floor:
+                    out = _ceil_date(unit, src.data.astype(jnp.int64), days, None, None)
+                return Column(out.astype(physical_dtype(stype)), stype, src.mask)
+            days = timestamp_to_days(src.data)
+            tod = timestamp_time_of_day_us(src.data)
+            fdays, ftod = trunc_date(unit, days, tod)
+            floored = fdays * US_PER_DAY + (ftod if ftod is not None else 0)
+            if is_floor:
+                return Column(floored.astype(jnp.int64), stype, src.mask)
+            out = _ceil_date(unit, days, fdays, tod, floored)
+            return Column(out.astype(jnp.int64), stype, src.mask)
+        (a,) = args[:1]
+        fn = jnp.floor if is_floor else jnp.ceil
+        pyfn = math.floor if is_floor else math.ceil
+        return numeric_op(fn, pyfn)([a], stype, ctx)
+
+    return op
+
+
+def _ceil_date(unit, days, floored_days, tod, floored_us):
+    """CEIL(ts TO unit) = floor(ts) if already aligned else floor + 1 unit."""
+    u = unit.upper()
+    if floored_us is None:
+        aligned = days == floored_days
+        if u == "YEAR":
+            y, m, d = civil_from_days(days)
+            return jnp.where(aligned, days, days_from_civil(y + 1, jnp.ones_like(m), jnp.ones_like(d)))
+        if u == "MONTH":
+            return jnp.where(aligned, days, add_months(floored_days, 1))
+        if u == "WEEK":
+            return jnp.where(aligned, days, floored_days + 7)
+        return days
+    orig = days * US_PER_DAY + tod
+    aligned = orig == floored_us
+    if u == "YEAR":
+        y, m, d = civil_from_days(days)
+        nxt = days_from_civil(y + 1, jnp.ones_like(m), jnp.ones_like(d)) * US_PER_DAY
+        return jnp.where(aligned, orig, nxt)
+    if u == "MONTH":
+        nxt = add_months(timestamp_to_days(floored_us), 1) * US_PER_DAY
+        return jnp.where(aligned, orig, nxt)
+    step = {"DAY": US_PER_DAY, "HOUR": 3_600_000_000, "MINUTE": 60_000_000,
+            "SECOND": 1_000_000, "WEEK": 7 * US_PER_DAY}.get(u)
+    if step is None:
+        raise NotImplementedError(f"CEIL unit {unit}")
+    return jnp.where(aligned, orig, floored_us + step)
+
+
+# ---------------------------------------------------------------------------
+# random (seeded, reference call.py:558-639)
+# ---------------------------------------------------------------------------
+
+def rand_op(args: List[Value], stype: SqlType, ctx) -> Value:
+    seed = int(args[0].value) if args else np.random.randint(0, 2**31)
+    key = jax.random.PRNGKey(seed)
+    out = jax.random.uniform(key, (ctx.num_rows,), dtype=jnp.float64)
+    return Column(out, DOUBLE, None)
+
+
+def rand_integer_op(args: List[Value], stype: SqlType, ctx) -> Value:
+    if len(args) == 2:
+        seed = int(args[0].value)
+        bound = int(args[1].value)
+    else:
+        seed = np.random.randint(0, 2**31)
+        bound = int(args[0].value)
+    key = jax.random.PRNGKey(seed)
+    out = jax.random.randint(key, (ctx.num_rows,), 0, bound)
+    return Column(out.astype(jnp.int32), stype, None)
+
+
+# ---------------------------------------------------------------------------
+# CAST — see cast.py; registered in the mapping there to avoid cycles
+# ---------------------------------------------------------------------------
+
+def _search_op(args, stype, ctx):
+    """SEARCH(x, Sarg): range-set membership — produced by our optimizer for
+    range predicates (Calcite Sarg equivalent, reference literal.py:12-71)."""
+    expr, ranges = args
+    # ranges is a Scalar holding a list of (lo, lo_open, hi, hi_open) tuples
+    out = None
+    for lo, lo_open, hi, hi_open in ranges.value:
+        conds = []
+        if lo is not None:
+            conds.append(comparison(">" if lo_open else ">=")(
+                [expr, Scalar(lo, expr.stype)], BOOLEAN, ctx))
+        if hi is not None:
+            conds.append(comparison("<" if hi_open else "<=")(
+                [expr, Scalar(hi, expr.stype)], BOOLEAN, ctx))
+        if not conds:
+            piece = Scalar(True, BOOLEAN)
+        else:
+            piece = conds[0]
+            for c in conds[1:]:
+                piece = logical_and([piece, c], BOOLEAN, ctx)
+        out = piece if out is None else logical_or([out, piece], BOOLEAN, ctx)
+    return out if out is not None else Scalar(False, BOOLEAN)
+
+
+# ===========================================================================
+# THE MAPPING  (reference: RexCallPlugin.OPERATION_MAPPING call.py:685-762)
+# ===========================================================================
+
+OPERATION_MAPPING = {
+    # logic
+    "AND": logical_and,
+    "OR": logical_or,
+    "NOT": logical_not,
+    # comparison
+    "=": comparison("="),
+    "<>": comparison("<>"),
+    "<": comparison("<"),
+    "<=": comparison("<="),
+    ">": comparison(">"),
+    ">=": comparison(">="),
+    # arithmetic
+    "+": temporal_plus_minus(+1),
+    "-": temporal_plus_minus(-1),
+    "*": numeric_op(lambda a, b: a * b, lambda a, b: a * b),
+    "/": numeric_op(sql_div, _py_div),
+    "%": numeric_op(lambda a, b: jnp.sign(a) * (jnp.abs(a) % jnp.abs(b)),
+                    lambda a, b: math.copysign(abs(a) % abs(b), a)),
+    "MOD": numeric_op(lambda a, b: jnp.sign(a) * (jnp.abs(a) % jnp.abs(b)),
+                      lambda a, b: math.copysign(abs(a) % abs(b), a)),
+    "NEGATE": numeric_op(lambda a: -a, lambda a: -a),
+    # is-ness
+    "IS_NULL": is_null,
+    "IS_NOT_NULL": is_not_null,
+    "IS_TRUE": _is_bool(True, False),
+    "IS_NOT_TRUE": _is_bool(True, True),
+    "IS_FALSE": _is_bool(False, False),
+    "IS_NOT_FALSE": _is_bool(False, True),
+    "IS_DISTINCT_FROM": is_distinct_from(False),
+    "IS_NOT_DISTINCT_FROM": is_distinct_from(True),
+    # conditional
+    "CASE": case_op,
+    "COALESCE": coalesce_op,
+    "IFNULL": coalesce_op,
+    "NVL": coalesce_op,
+    "NULLIF": nullif_op,
+    "GREATEST": greatest_least(True),
+    "LEAST": greatest_least(False),
+    "IN_LIST": in_list_op,
+    "SEARCH": _search_op,
+    # pattern matching
+    "LIKE": like_op("LIKE"),
+    "ILIKE": like_op("ILIKE"),
+    "SIMILAR": like_op("SIMILAR"),
+    # math
+    "ABS": numeric_op(jnp.abs, abs),
+    "SQRT": numeric_op(jnp.sqrt, math.sqrt),
+    "EXP": numeric_op(jnp.exp, math.exp),
+    "LN": numeric_op(jnp.log, math.log),
+    "LOG10": numeric_op(jnp.log10, math.log10),
+    "LOG": numeric_op(lambda a, b=None: jnp.log(a) if b is None else jnp.log(b) / jnp.log(a),
+                      lambda a, b=None: math.log(a) if b is None else math.log(b, a)),
+    "POWER": numeric_op(jnp.power, math.pow),
+    "POW": numeric_op(jnp.power, math.pow),
+    "SIN": numeric_op(jnp.sin, math.sin),
+    "COS": numeric_op(jnp.cos, math.cos),
+    "TAN": numeric_op(jnp.tan, math.tan),
+    "ASIN": numeric_op(jnp.arcsin, math.asin),
+    "ACOS": numeric_op(jnp.arccos, math.acos),
+    "ATAN": numeric_op(jnp.arctan, math.atan),
+    "ATAN2": numeric_op(jnp.arctan2, math.atan2),
+    "SINH": numeric_op(jnp.sinh, math.sinh),
+    "COSH": numeric_op(jnp.cosh, math.cosh),
+    "TANH": numeric_op(jnp.tanh, math.tanh),
+    "COT": numeric_op(lambda a: 1.0 / jnp.tan(a), lambda a: 1.0 / math.tan(a)),
+    "DEGREES": numeric_op(jnp.degrees, math.degrees),
+    "RADIANS": numeric_op(jnp.radians, math.radians),
+    "SIGN": numeric_op(jnp.sign, lambda a: (a > 0) - (a < 0)),
+    "CBRT": numeric_op(jnp.cbrt, lambda a: a ** (1.0 / 3.0)),
+    "ROUND": numeric_op(
+        lambda a, d=None: jnp.round(a) if d is None else jnp.round(a * (10.0 ** d)) / (10.0 ** d),
+        lambda a, d=None: round(a) if d is None else round(a, int(d))),
+    "TRUNCATE": numeric_op(
+        lambda a, d=None: jnp.trunc(a) if d is None else jnp.trunc(a * (10.0 ** d)) / (10.0 ** d),
+        lambda a, d=None: math.trunc(a) if d is None else math.trunc(a * 10 ** d) / 10 ** d),
+    "PI": lambda args, stype, ctx: Scalar(math.pi, DOUBLE),
+    "FLOOR": floor_ceil_op(True),
+    "CEIL": floor_ceil_op(False),
+    "CEILING": floor_ceil_op(False),
+    "RAND": rand_op,
+    "RANDOM": rand_op,
+    "RAND_INTEGER": rand_integer_op,
+    # strings
+    "||": concat_op,
+    "CONCAT": concat_op,
+    "UPPER": string_unary(str.upper),
+    "LOWER": string_unary(str.lower),
+    "INITCAP": string_unary(lambda s: re.sub(r"[a-zA-Z]+", lambda m: m.group(0).capitalize(), s)),
+    "REVERSE": string_unary(lambda s: s[::-1]),
+    "CHAR_LENGTH": string_unary(len),
+    "CHARACTER_LENGTH": string_unary(len),
+    "LENGTH": string_unary(len),
+    "OCTET_LENGTH": string_unary(lambda s: len(s.encode())),
+    "ASCII": string_unary(lambda s: ord(s[0]) if s else 0),
+    "CHR": numeric_op(None, None) if False else string_nary(lambda c: chr(int(c))),
+    "SUBSTRING": string_nary(_substring),
+    "SUBSTR": string_nary(_substring),
+    "TRIM": string_nary(_trim),
+    "LTRIM": string_nary(lambda s, c=" ": s.lstrip(c)),
+    "RTRIM": string_nary(lambda s, c=" ": s.rstrip(c)),
+    "BTRIM": string_nary(lambda s, c=" ": s.strip(c)),
+    "POSITION": string_nary(lambda needle, hay: hay.find(needle) + 1),
+    "STRPOS": string_nary(lambda hay, needle: hay.find(needle) + 1),
+    "OVERLAY": string_nary(_overlay),
+    "REPLACE": string_nary(lambda s, old, new: s.replace(old, new)),
+    "REPEAT": string_nary(lambda s, n_: s * int(n_)),
+    "LEFT": string_nary(lambda s, n_: s[: int(n_)] if n_ >= 0 else s[: max(len(s) + int(n_), 0)]),
+    "RIGHT": string_nary(lambda s, n_: s[-int(n_):] if n_ > 0 else (s[-(len(s) + int(n_)):] if len(s) + int(n_) > 0 else "")),
+    "LPAD": string_nary(lambda s, n_, p=" ": s[: int(n_)] if len(s) >= int(n_) else (p * int(n_))[: int(n_) - len(s)] + s),
+    "RPAD": string_nary(lambda s, n_, p=" ": s[: int(n_)] if len(s) >= int(n_) else s + (p * int(n_))[: int(n_) - len(s)]),
+    "SPLIT_PART": string_nary(_split_part),
+    "TRANSLATE": string_nary(lambda s, frm, to: s.translate(str.maketrans(frm, to[: len(frm)].ljust(len(frm))))),
+    "REGEXP_REPLACE": string_nary(lambda s, p, r: re.sub(p, r, s)),
+    # datetime
+    "EXTRACT": extract_op,
+    "YEAR": lambda args, stype, ctx: extract_op([Scalar("YEAR", SqlType("SYMBOL")), args[0]], stype, ctx),
+    "MONTH": lambda args, stype, ctx: extract_op([Scalar("MONTH", SqlType("SYMBOL")), args[0]], stype, ctx),
+    "DAY": lambda args, stype, ctx: extract_op([Scalar("DAY", SqlType("SYMBOL")), args[0]], stype, ctx),
+    "HOUR": lambda args, stype, ctx: extract_op([Scalar("HOUR", SqlType("SYMBOL")), args[0]], stype, ctx),
+    "MINUTE": lambda args, stype, ctx: extract_op([Scalar("MINUTE", SqlType("SYMBOL")), args[0]], stype, ctx),
+    "SECOND": lambda args, stype, ctx: extract_op([Scalar("SECOND", SqlType("SYMBOL")), args[0]], stype, ctx),
+    "QUARTER": lambda args, stype, ctx: extract_op([Scalar("QUARTER", SqlType("SYMBOL")), args[0]], stype, ctx),
+    "DAYOFWEEK": lambda args, stype, ctx: extract_op([Scalar("DOW", SqlType("SYMBOL")), args[0]], stype, ctx),
+    "DAYOFMONTH": lambda args, stype, ctx: extract_op([Scalar("DAY", SqlType("SYMBOL")), args[0]], stype, ctx),
+    "DAYOFYEAR": lambda args, stype, ctx: extract_op([Scalar("DOY", SqlType("SYMBOL")), args[0]], stype, ctx),
+    "WEEK": lambda args, stype, ctx: extract_op([Scalar("WEEK", SqlType("SYMBOL")), args[0]], stype, ctx),
+}

@@ -1,0 +1,303 @@
+"""Presto-wire-protocol HTTP server.
+
+Re-implements the reference server (/root/reference/dask_sql/server/app.py):
+``POST /v1/statement`` submits SQL, ``GET /v1/status/{uuid}`` polls,
+``DELETE /v1/cancel/{uuid}`` cancels, ``GET /v1/empty`` returns an empty
+result — with async execution via a thread pool + futures registry mirroring
+the reference's dask-client future_list (app.py:69-95).
+
+Built on stdlib http.server (FastAPI/uvicorn are not in this image); the wire
+format matches the reference's responses.py so presto/trino clients work.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import uuid as uuid_mod
+from concurrent.futures import Future, ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# presto wire responses (reference server/responses.py)
+# ---------------------------------------------------------------------------
+
+def _stats(state: str, info: Optional["_QueryInfo"] = None) -> dict:
+    """Wire-shape of reference responses.py:11-49, but FILLED: the reference
+    hardcodes zeros; here cpu/wall/queued times, processed rows/bytes, the
+    compile-vs-cache-hit split and device peak memory come from the actual
+    execution (physical/compiled.py stats + timers)."""
+    out = {
+        "state": state, "queued": state == "QUEUED", "scheduled": True,
+        "nodes": 1, "totalSplits": 1, "queuedSplits": int(state == "QUEUED"),
+        "runningSplits": int(state == "RUNNING"),
+        "completedSplits": int(state == "FINISHED"),
+        "cpuTimeMillis": 0, "wallTimeMillis": 0,
+        "queuedTimeMillis": 0, "elapsedTimeMillis": 0, "processedRows": 0,
+        "processedBytes": 0, "peakMemoryBytes": 0,
+    }
+    if info is not None:
+        now = time.monotonic()
+        started = info.started or now
+        finished = info.finished or now
+        out["queuedTimeMillis"] = int(1000 * (started - info.submitted))
+        out["wallTimeMillis"] = int(1000 * max(finished - started, 0))
+        out["elapsedTimeMillis"] = int(1000 * (finished - info.submitted))
+        out["cpuTimeMillis"] = int(1000 * info.cpu_sec)
+        out["processedRows"] = info.rows
+        out["processedBytes"] = info.bytes
+        out["peakMemoryBytes"] = info.peak_memory
+        out["compiledPrograms"] = info.compiles
+        out["programCacheHits"] = info.cache_hits
+    return out
+
+
+class _QueryInfo:
+    __slots__ = ("submitted", "started", "finished", "cpu_sec", "rows",
+                 "bytes", "peak_memory", "compiles", "cache_hits")
+
+    def __init__(self):
+        self.submitted = time.monotonic()
+        self.started = None
+        self.finished = None
+        self.cpu_sec = 0.0
+        self.rows = 0
+        self.bytes = 0
+        self.peak_memory = 0
+        self.compiles = 0
+        self.cache_hits = 0
+
+
+def _run_tracked(context, sql: str, info: _QueryInfo):
+    from ..physical import compiled
+
+    info.started = time.monotonic()
+    c0 = dict(compiled.stats)
+    # thread_time, not process_time: concurrent pool queries must not
+    # inflate each other's cpu accounting
+    cpu0 = time.thread_time()
+    try:
+        table = context.sql(sql)
+    finally:
+        info.cpu_sec = time.thread_time() - cpu0
+        info.finished = time.monotonic()
+        info.compiles = compiled.stats["compiles"] - c0["compiles"]
+        info.cache_hits = compiled.stats["hits"] - c0["hits"]
+    if table is not None and getattr(table, "num_columns", 0):
+        info.rows = table.num_rows
+        info.bytes = sum(int(getattr(c.data, "nbytes", 0))
+                         for c in table.columns)
+    try:
+        import jax
+        mem = jax.local_devices()[0].memory_stats() or {}
+        info.peak_memory = int(mem.get("peak_bytes_in_use", 0))
+    except Exception:
+        pass
+    return table
+
+
+_TYPE_MAP = {
+    "BOOLEAN": "boolean", "TINYINT": "tinyint", "SMALLINT": "smallint",
+    "INTEGER": "integer", "BIGINT": "bigint", "FLOAT": "real",
+    "DOUBLE": "double", "DECIMAL": "decimal", "VARCHAR": "varchar",
+    "CHAR": "char", "DATE": "date", "TIMESTAMP": "timestamp",
+    "TIME": "time", "INTERVAL_DAY_TIME": "interval day to second",
+    "INTERVAL_YEAR_MONTH": "interval year to month", "NULL": "unknown",
+}
+
+
+def _columns_payload(table) -> list:
+    cols = []
+    for name, col in zip(table.names, table.columns):
+        t = _TYPE_MAP.get(col.stype.name, "varchar")
+        cols.append({
+            "name": name, "type": t,
+            "typeSignature": {"rawType": t, "arguments": []},
+        })
+    return cols
+
+
+def _data_payload(table) -> list:
+    rows = []
+    for row in table.to_pylist():
+        out = []
+        for v in row:
+            if hasattr(v, "isoformat"):
+                v = v.isoformat(sep=" ") if hasattr(v, "date") else v.isoformat()
+            elif hasattr(v, "item"):
+                v = v.item()
+            out.append(v)
+        rows.append(out)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class _AppState:
+    def __init__(self, context):
+        self.context = context
+        self.pool = ThreadPoolExecutor(max_workers=4)
+        self.future_list: Dict[str, Future] = {}
+        self.query_info: Dict[str, _QueryInfo] = {}
+        self.lock = threading.Lock()
+
+
+def _make_handler(state: _AppState, base_url: str):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            logger.debug("server: " + fmt, *args)
+
+        def _send(self, code: int, payload: Optional[dict]):
+            body = json.dumps(payload or {}).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        # GET /v1/empty  |  GET /v1/status/{uuid}
+        def do_GET(self):
+            if self.path.rstrip("/") == "/v1/empty":
+                self._send(200, {
+                    "id": "empty", "infoUri": base_url,
+                    "columns": [], "data": [], "stats": _stats("FINISHED"),
+                })
+                return
+            if self.path.startswith("/v1/status/"):
+                uid = self.path[len("/v1/status/"):].strip("/")
+                fut = state.future_list.get(uid)
+                if fut is None:
+                    self._send(404, _error_payload("Unknown query id", uid))
+                    return
+                info = state.query_info.get(uid)
+                if not fut.done():
+                    self._send(200, {
+                        "id": uid, "infoUri": base_url,
+                        "nextUri": f"{base_url}/v1/status/{uid}",
+                        "partialCancelUri": f"{base_url}/v1/cancel/{uid}",
+                        "stats": _stats("RUNNING", info),
+                    })
+                    return
+                try:
+                    table = fut.result()
+                except Exception as e:
+                    del state.future_list[uid]
+                    state.query_info.pop(uid, None)
+                    self._send(200, _error_payload(str(e), uid))
+                    return
+                del state.future_list[uid]
+                state.query_info.pop(uid, None)
+                payload = {
+                    "id": uid, "infoUri": base_url,
+                    "stats": _stats("FINISHED", info),
+                }
+                if table is not None and table.num_columns:
+                    payload["columns"] = _columns_payload(table)
+                    payload["data"] = _data_payload(table)
+                self._send(200, payload)
+                return
+            self._send(404, {"error": "not found"})
+
+        # POST /v1/statement
+        def do_POST(self):
+            if self.path.rstrip("/") != "/v1/statement":
+                self._send(404, {"error": "not found"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            sql = self.rfile.read(length).decode()
+            uid = str(uuid_mod.uuid4())
+            info = _QueryInfo()
+            state.query_info[uid] = info
+            fut = state.pool.submit(_run_tracked, state.context, sql, info)
+            state.future_list[uid] = fut
+            self._send(200, {
+                "id": uid, "infoUri": base_url,
+                "nextUri": f"{base_url}/v1/status/{uid}",
+                "partialCancelUri": f"{base_url}/v1/cancel/{uid}",
+                "stats": _stats("QUEUED", info),
+            })
+
+        # DELETE /v1/cancel/{uuid}
+        def do_DELETE(self):
+            if self.path.startswith("/v1/cancel/"):
+                uid = self.path[len("/v1/cancel/"):].strip("/")
+                fut = state.future_list.pop(uid, None)
+                state.query_info.pop(uid, None)
+                if fut is None:
+                    self._send(404, _error_payload("Unknown query id", uid))
+                    return
+                fut.cancel()
+                self._send(200, None)
+                return
+            self._send(404, {"error": "not found"})
+
+    return Handler
+
+
+def _error_payload(message: str, uid: str) -> dict:
+    """reference responses.py:119-139 ErrorResults shape."""
+    return {
+        "id": uid, "infoUri": "", "stats": _stats("FAILED"),
+        "error": {
+            "message": message, "errorCode": 1,
+            "errorName": "GENERIC_ERROR", "errorType": "USER_ERROR",
+            "errorLocation": {"lineNumber": 1, "columnNumber": 1},
+        },
+    }
+
+
+def run_server(context=None, host: str = "0.0.0.0", port: int = 8080,
+               startup: bool = False, log_level=None, blocking: bool = True):
+    """Start the SQL server (reference server/app.py:97-183).
+
+    With ``blocking=False`` returns the (started) server object for tests.
+    """
+    if log_level:
+        logging.basicConfig(level=log_level)
+    from ..context import Context
+
+    context = context or Context()
+    if startup:
+        context.sql("SELECT 1 + 1")
+
+    state = _AppState(context)
+    # bind first so port=0 (ephemeral) yields correct nextUri links
+    server = ThreadingHTTPServer((host, port), _make_handler(state, ""))
+    base_url = f"http://{host}:{server.server_port}"
+    server.RequestHandlerClass = _make_handler(state, base_url)
+    server.app_state = state
+    context.server = server
+    if not blocking:
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        return server
+    try:
+        logger.info("dask-sql-tpu server listening on %s", base_url)
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return server
+
+
+def main():  # pragma: no cover - console entry
+    import argparse
+
+    parser = argparse.ArgumentParser(description="dask-sql-tpu presto server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--startup", action="store_true")
+    parser.add_argument("--log-level", default=None)
+    args = parser.parse_args()
+    run_server(host=args.host, port=args.port, startup=args.startup,
+               log_level=args.log_level)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -36,7 +36,11 @@ python -m pytest tests/integration/test_distributed.py \
 
 echo "=== [4/4] bare install smoke ==="
 TMPDIR=$(mktemp -d)
-pip install --quiet --target "$TMPDIR/site" . >/dev/null
+# --no-build-isolation/--no-deps: the zero-egress image can fetch neither
+# the isolated build env's setuptools nor the install_requires; the venv
+# already carries both, and the smoke below resolves deps from the venv
+pip install --quiet --no-build-isolation --no-deps \
+    --target "$TMPDIR/site" . >/dev/null
 (cd /tmp && PYTHONPATH="$TMPDIR/site" python - <<'EOF'
 import jax; jax.config.update('jax_platforms', 'cpu')
 import pandas as pd

@@ -353,13 +353,13 @@ def test_cache_hit_on_reloaded_identical_data(c):
 
 
 @_needs_compiled
-def test_wide_build_side_uses_gather_strategy(c, monkeypatch):
-    """Past the build-width cutoff the TPU path must fall back to the
-    probe-gather join (ADVICE r1 finding 3) and still produce exact
-    results."""
+def test_wide_build_side_merge_join(c, monkeypatch):
+    """Wide build sides ride the sorted-probe join directly: its channel
+    count is constant (columns arrive by row-id gathers), so the r1/r2
+    width-triggered strategy switch no longer exists and width must not
+    change results or the single-program property."""
     from dask_sql_tpu.ops import pallas_kernels
     monkeypatch.setattr(pallas_kernels, "_on_tpu", lambda: True)
-    monkeypatch.setattr(compiled, "_MERGE_BUILD_WIDTH", 2)
     wide = pd.DataFrame({"user_id": [1, 2, 3],
                          **{f"w{i}": [i, i + 1, i + 2] for i in range(6)}})
     c.create_table("wide_build", wide)
