@@ -360,6 +360,7 @@ def test_wide_build_side_merge_join(c, monkeypatch):
     change results or the single-program property."""
     from dask_sql_tpu.ops import pallas_kernels
     monkeypatch.setattr(pallas_kernels, "_on_tpu", lambda: True)
+    monkeypatch.delenv("DSQL_STRATEGY", raising=False)
     wide = pd.DataFrame({"user_id": [1, 2, 3],
                          **{f"w{i}": [i, i + 1, i + 2] for i in range(6)}})
     c.create_table("wide_build", wide)
