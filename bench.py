@@ -10,29 +10,39 @@ pandas executing hand-written implementations of the same 22 queries on the
 same host (benchmarks/pandas_tpch.py) — the reference's single-partition
 execution substrate IS pandas, and BASELINE.md publishes no absolute numbers.
 
-Resilience design (the tunneled TPU can hang at init for 25+ minutes or
-wedge mid-run with no exception — both observed):
+Budget design (round 4 — the round-3 run was killed by the caller's outer
+timeout before the JSON line printed, which is a total loss regardless of
+engine quality):
 
-- the platform probe runs in a subprocess with a timeout, RETRIES once,
-  and falls back to CPU only after both attempts fail;
-- queries run in STAGES, each stage a separate child process with its own
-  slice of the remaining time budget, cheap-compile/high-value queries
-  first; each completed query is written to a progress file immediately,
-  so a wedge loses at most the rest of one stage and partial TPU numbers
-  are always recorded;
-- generated data is cached on disk (feather) once and memory-mapped by
-  every stage child, so per-stage process isolation does not re-pay
-  generation.
+- ONE absolute deadline is computed at entry (``BENCH_RUN_TIMEOUT``, default
+  1500 s — conservatively inside the driver's observed kill window);
+- the pandas baseline runs FIRST (it is cheap and cannot wedge), so engine
+  trouble can never erase the comparison;
+- engine queries run in ONE child process (the SF1 host->device transfer over
+  the tunneled TPU costs ~2 min, so per-stage process isolation would pay it
+  repeatedly); the child journals every completed query to a progress file
+  and retires itself at its own deadline, and the parent restarts a child on
+  the remaining queries only while enough budget remains;
+- emission is structurally guaranteed: a watchdog thread fires just before
+  the deadline, SIGTERM/SIGINT are handled, and an atexit hook is the last
+  resort — all funnel into one idempotent emitter that reads the progress
+  journal, so being killed mid-run still yields a parsed partial result.
 
-``detail`` records the platform each query actually ran on, per-query
-times, compile stats, and device-memory stats, so the result can't
-silently hide a CPU fallback or a partial run.
+Compile latency (40-200 s/program cold over the tunneled TPU) is managed by
+the persistent XLA cache + learned-caps file under a STABLE path, so a bench
+run primed by an earlier run on the same host loads programs in ~0.3 s.
+``detail`` records the platform each query ran on, per-query times, compile
+stats and cold/warm cache evidence, so the result can't silently hide a CPU
+fallback or a partial run.
 """
+import atexit
 import json
 import math
 import os
+import signal
 import sys
 import tempfile
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -43,26 +53,25 @@ REPS = int(os.environ.get("BENCH_REPS", "3"))
 # cold pandas sample would systematically inflate vs_baseline
 PANDAS_REPS = int(os.environ.get("BENCH_PANDAS_REPS", str(REPS)))
 WARMUP_THREADS = int(os.environ.get("BENCH_WARMUP_THREADS", "8"))
-PLATFORM_PROBE_TIMEOUT = float(os.environ.get("BENCH_PLATFORM_TIMEOUT", "150"))
-TOTAL_BUDGET = float(os.environ.get("BENCH_RUN_TIMEOUT", "2800"))
+PLATFORM_PROBE_TIMEOUT = float(os.environ.get("BENCH_PLATFORM_TIMEOUT", "120"))
+TOTAL_BUDGET = float(os.environ.get("BENCH_RUN_TIMEOUT", "1500"))
+PANDAS_BUDGET = float(os.environ.get("BENCH_PANDAS_TIMEOUT", "420"))
+EMIT_MARGIN = float(os.environ.get("BENCH_EMIT_MARGIN", "25"))
+# minimum budget worth starting an engine child with: one table transfer
+# (~130 s at SF1 over the tunnel) plus at least one compile+measure
+MIN_CHILD_BUDGET = float(os.environ.get("BENCH_MIN_CHILD_BUDGET", "240"))
 
-# stage order: cheap compiles + headline queries first, so a wedge later
-# still leaves a meaningful recorded subset
-STAGES = [
-    [6, 1, 3, 12, 14, 19],
-    [4, 5, 10, 15, 20, 22],
-    [2, 11, 13, 16, 17, 18],
-    [7, 8, 9, 21],
-]
+# priority order: cheap compiles + headline queries first, so an engine child
+# that dies mid-run still leaves the most meaningful recorded subset
+PRIORITY = [6, 1, 3, 12, 14, 19, 4, 5, 10, 15, 20, 22,
+            2, 11, 13, 16, 17, 18, 7, 8, 9, 21]
 
 
-def _stages_covering(all_qids):
-    """STAGES plus an overflow stage for any query id not hardcoded above —
-    a query added to benchmarks.tpch.QUERIES is never silently dropped."""
-    listed = {q for s in STAGES for q in s}
-    extra = sorted(q for q in all_qids if q not in listed)
-    stages = [list(s) for s in STAGES] + ([extra] if extra else [])
-    return [[q for q in s if q in all_qids] for s in stages]
+def _order(all_qids):
+    """PRIORITY first, then any query id not hardcoded above — a query added
+    to benchmarks.tpch.QUERIES is never silently dropped."""
+    extra = sorted(q for q in all_qids if q not in PRIORITY)
+    return [q for q in PRIORITY if q in all_qids] + extra
 
 
 def _geomean(xs):
@@ -115,8 +124,10 @@ def _load_data(cache_dir: str):
 
 def _stage_main():
     """Child: run BENCH_STAGE_QUERIES against the cached data, appending one
-    JSON line per completed query to the progress file."""
+    JSON line per completed query to the progress file, retiring itself
+    cleanly at BENCH_CHILD_DEADLINE (unix seconds)."""
     platform = os.environ.get("BENCH_PLATFORM_CHOICE", "default")
+    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", "0")) or None
     import jax
 
     if platform != "default":
@@ -133,7 +144,11 @@ def _stage_main():
     for name, frame in data.items():
         c.create_table(name, frame)
     load_sec = time.perf_counter() - t0
+    del data
     real_platform = jax.devices()[0].platform
+
+    def left():
+        return float("inf") if deadline is None else deadline - time.time()
 
     def emit(rec):
         with open(progress_path, "a") as f:
@@ -142,29 +157,86 @@ def _stage_main():
 
     # warmup = compilation; compiles overlap across threads (tracing holds
     # the GIL but the backend compile releases it), which matters on the
-    # tunneled TPU where a single compile can take minutes
-    t0 = time.perf_counter()
+    # tunneled TPU where a single cold compile can take minutes.  Each
+    # query's compile wall-time is journaled: with the persistent XLA cache
+    # primed this is the warm-load evidence (~sub-second), cold it is the
+    # true compile cost.
+    compiled_ok = set()
+    lock = threading.Lock()
+
+    warm_t0 = time.perf_counter()
+    last_warm_done = [0.0]
+
+    def warm_one(q):
+        t0 = time.perf_counter()
+        c.sql(QUERIES[q], return_futures=False)
+        dt = time.perf_counter() - t0
+        with lock:
+            compiled_ok.add(q)
+            last_warm_done[0] = time.perf_counter() - warm_t0
+        emit({"warm_q": q, "sec": round(dt, 3)})
+
+    t0 = warm_t0
+    futs = {}
     if WARMUP_THREADS > 1 and len(qids) > 1:
         from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(min(WARMUP_THREADS, len(qids))) as pool:
-            list(pool.map(lambda q: c.sql(QUERIES[q], return_futures=False),
-                          qids))
+        # do NOT pool.shutdown(wait=True) anywhere: a thread wedged in a
+        # tunnel compile must not hang the child — the os._exit at the
+        # bottom reaps everything
+        pool = ThreadPoolExecutor(min(WARMUP_THREADS, len(qids)))
+        futs = {q: pool.submit(warm_one, q) for q in qids}
     else:
         for q in qids:
-            c.sql(QUERIES[q], return_futures=False)
-    warmup_sec = time.perf_counter() - t0
+            if left() < 20:
+                break
+            try:
+                warm_one(q)
+            except Exception as e:
+                emit({"warm_fail": q, "error": repr(e)[:300]})
 
     from dask_sql_tpu.physical import compiled
 
-    for qid in qids:
-        best = float("inf")
-        for _ in range(REPS):
-            t0 = time.perf_counter()
-            # end-to-end: SQL text to host pandas frame (matches what the
-            # pandas baseline measures)
-            c.sql(QUERIES[qid], return_futures=False)
-            best = min(best, time.perf_counter() - t0)
-        emit({"q": qid, "sec": round(best, 4), "platform": real_platform})
+    # measure-as-compiled: a query is timed as soon as its warmup lands,
+    # while the remaining compiles keep overlapping in the pool — one slow
+    # compile (Q13: 180 s observed over the tunnel) can no longer stall the
+    # whole run behind it
+    measured, failed = set(), set()
+    while left() > 15:
+        for q, f in list(futs.items()):
+            if q not in failed and f.done() and f.exception() is not None:
+                failed.add(q)
+                emit({"warm_fail": q, "error": repr(f.exception())[:300]})
+        # sample the all-done flag BEFORE the ready snapshot: the last
+        # warmup can land between the two, and checking in this order
+        # guarantees one more loop pass sees it in compiled_ok
+        all_done = bool(futs) and all(f.done() for f in futs.values())
+        with lock:
+            ready = [q for q in qids
+                     if q in compiled_ok and q not in measured]
+        if not ready:
+            if len(measured) + len(failed) >= len(qids) or all_done:
+                break
+            if not futs:
+                break
+            time.sleep(2)
+            continue
+        for qid in ready:
+            if left() < 15:
+                break
+            best = float("inf")
+            for _ in range(REPS):
+                t0r = time.perf_counter()
+                # end-to-end: SQL text to host pandas frame (matches what
+                # the pandas baseline measures)
+                c.sql(QUERIES[qid], return_futures=False)
+                best = min(best, time.perf_counter() - t0r)
+                if left() < 10:
+                    break
+            measured.add(qid)
+            emit({"q": qid, "sec": round(best, 4),
+                  "platform": real_platform})
+    # wall time until the LAST warmup landed (measurement overlaps it)
+    warmup_sec = last_warm_done[0] or (time.perf_counter() - t0)
 
     mem = {}
     try:
@@ -190,13 +262,171 @@ def _stage_main():
     emit({"stage_done": True, "load_sec": round(load_sec, 1),
           "warmup_sec": round(warmup_sec, 1), "device_memory": mem,
           "compiled_stats": dict(compiled.stats)})
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)  # don't join wedged warmup threads
 
 
 def main():
     import subprocess
 
-    t_start = time.perf_counter()
+    t_start = time.monotonic()
+    deadline = t_start + TOTAL_BUDGET
+
+    state = {
+        "progress": None, "qids": [], "sf": SF, "n_lineitem": 0,
+        "gen_sec": 0.0, "platform_choice": "?", "stage_meta": [],
+        "emitted": False,
+    }
+    emit_lock = threading.Lock()
+
+    def emit_final(reason=None):
+        """Idempotent: compute the metric line from the progress journal and
+        print it.  Callable from the watchdog thread, signal handlers,
+        atexit, or the happy path — whoever gets there first wins.  The
+        lock is held through the PRINT: a second caller (watchdog about to
+        os._exit) must block until the line is fully out, or the exit
+        could truncate it mid-write."""
+        if state.get("emitting_thread") == threading.get_ident():
+            # re-entered from a signal handler interrupting our own print:
+            # returning lets the interrupted emission complete
+            return
+        # block TERM/INT for the duration on the main thread: a handler
+        # firing between lock acquisition and the marker assignment would
+        # re-enter emit_final and deadlock on the non-reentrant lock
+        is_main = threading.current_thread() is threading.main_thread()
+        old_mask = None
+        if is_main:
+            try:
+                old_mask = signal.pthread_sigmask(
+                    signal.SIG_BLOCK, {signal.SIGTERM, signal.SIGINT})
+            except (ValueError, OSError):
+                pass
+        try:
+            with emit_lock:
+                if state["emitted"]:
+                    return
+                state["emitting_thread"] = threading.get_ident()
+                try:
+                    _emit_locked(reason)
+                    state["emitted"] = True
+                finally:
+                    state["emitting_thread"] = None
+                    if state.get("die_after_emit"):
+                        os._exit(0)
+        finally:
+            if old_mask is not None:
+                signal.pthread_sigmask(signal.SIG_SETMASK, old_mask)
+
+    def _emit_locked(reason):
+        times, p_times, platforms = {}, {}, set()
+        warm_times, mem, cstats = {}, {}, {}
+        load_sec = warmup_sec = 0.0
+        try:
+            with open(state["progress"]) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if "q" in rec:
+                        times[rec["q"]] = rec["sec"]
+                        platforms.add(rec["platform"])
+                    elif "pq" in rec:
+                        p_times[rec["pq"]] = rec["sec"]
+                    elif "warm_q" in rec:
+                        warm_times[rec["warm_q"]] = rec["sec"]
+                    elif rec.get("stage_done"):
+                        load_sec += rec.get("load_sec", 0)
+                        warmup_sec += rec.get("warmup_sec", 0)
+                        for k, v in (rec.get("device_memory") or {}).items():
+                            mem[k] = max(mem.get(k, 0), v)
+                        for k, v in (rec.get("compiled_stats") or {}).items():
+                            cstats[k] = cstats.get(k, 0) + v
+        except Exception:
+            pass
+        done = sorted(times)
+        qids = state["qids"] or sorted(set(done) | set(p_times))
+        missing = [q for q in qids if q not in times]
+        if not done:
+            out = {"metric": "tpch_q1_q22_geomean_wall", "value": -1,
+                   "unit": "s", "vs_baseline": 0,
+                   "detail": {"error": "no engine queries completed",
+                              "reason": reason,
+                              "sf": state["sf"],
+                              "platform_choice": state["platform_choice"],
+                              "pandas_sec": {str(k): round(v, 4)
+                                             for k, v in p_times.items()},
+                              "stages": state["stage_meta"]}}
+        else:
+            geo_e = _geomean([times[q] for q in done])
+            based = [q for q in done if q in p_times]
+            geo_p = _geomean([p_times[q] for q in based]) if based else 0.0
+            ratio = (_geomean([p_times[q] / times[q] for q in based])
+                     if based else 0.0)
+            wins = sum(1 for q in based if times[q] < p_times[q])
+            out = {
+                "metric": "tpch_q1_q22_geomean_wall",
+                "value": round(geo_e, 4),
+                "unit": "s (geomean over completed queries, lower is better)",
+                "vs_baseline": round(ratio, 3),
+                "detail": {
+                    "sf": state["sf"],
+                    "platform": "/".join(sorted(platforms)),
+                    "lineitem_rows": state["n_lineitem"],
+                    "queries": len(done),
+                    "missing_queries": missing,
+                    "reason": reason,
+                    "stage_errors": state["stage_meta"],
+                    "engine_wins": wins,
+                    "engine_sec": {str(k): round(times[k], 4) for k in done},
+                    "pandas_sec": {str(k): round(p_times[k], 4)
+                                   for k in sorted(p_times)},
+                    "pandas_geomean_sec": round(geo_p, 4),
+                    "warm_or_compile_sec_per_query":
+                        {str(k): warm_times[k] for k in sorted(warm_times)},
+                    "gen_sec": round(state["gen_sec"], 1),
+                    "load_sec": round(load_sec, 1),
+                    "warmup_compile_sec": round(warmup_sec, 1),
+                    "compiled_stats": cstats,
+                    "device_memory": mem,
+                    "budget_sec": TOTAL_BUDGET,
+                    "elapsed_sec": round(time.monotonic() - t_start, 1),
+                },
+            }
+        print(json.dumps(out), flush=True)
+
+    def _die(signum, frame):
+        if state.get("emitting_thread") == threading.get_ident():
+            # the signal interrupted our own in-progress emission: mark it
+            # and let the print finish (the finally above exits for us)
+            state["die_after_emit"] = True
+            return
+        emit_final(reason=f"signal {signum}")
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _die)
+    signal.signal(signal.SIGINT, _die)
+    atexit.register(lambda: emit_final(reason="atexit"))
+
+    workdir = os.environ.get("BENCH_WORKDIR") or tempfile.mkdtemp(
+        prefix="bench_tpch_")
+    data_dir = os.path.join(workdir, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    progress = os.path.join(workdir, "progress.jsonl")
+    open(progress, "w").close()
+    state["progress"] = progress
+
+    # the watchdog is armed BEFORE any expensive step: from here on the
+    # metric line prints no matter where time runs out
+    watchdog = threading.Timer(
+        max(deadline - EMIT_MARGIN - time.monotonic(), 1.0),
+        lambda: (emit_final(reason="watchdog"), os._exit(0)))
+    watchdog.daemon = True
+    watchdog.start()
+
     platform = _probe_platform()
+    state["platform_choice"] = platform
     if platform == "cpu" and "BENCH_SF" not in os.environ:
         # tunnel-down fallback: the engine is TPU-first and the host may
         # have one core — a smaller SF keeps the fallback inside the
@@ -205,14 +435,11 @@ def main():
         sf = float(os.environ.get("BENCH_FALLBACK_SF", "0.1"))
     else:
         sf = SF
+    state["sf"] = sf
 
-    workdir = os.environ.get("BENCH_WORKDIR") or tempfile.mkdtemp(
-        prefix="bench_tpch_")
-    data_dir = os.path.join(workdir, "data")
-    os.makedirs(data_dir, exist_ok=True)
-    progress = os.path.join(workdir, "progress.jsonl")
-    open(progress, "w").close()
     gen_sec, n_lineitem = _cache_data(sf, data_dir)
+    state["gen_sec"] = gen_sec
+    state["n_lineitem"] = n_lineitem
 
     from benchmarks.tpch import QUERIES
     qids = sorted(QUERIES)
@@ -220,165 +447,131 @@ def main():
     if only:
         only_set = {int(x) for x in only.split(",")}
         qids = [q for q in qids if q in only_set]
-    stages = [s for s in _stages_covering(qids) if s]
+    qids = _order(qids)
+    state["qids"] = sorted(qids)
 
-    def run_stages(platform_choice, stage_lists, stage_data_dir,
-                   budget_end):
-        stage_meta = []
-        # STABLE (cross-invocation) compile + caps caches: an XLA program
-        # costs ~40-200 s to compile over the tunneled TPU but loads from
-        # the persistent cache in ~0.3 s, and a capacity-escalation
-        # recompile learned once should never be paid again — so a repeat
-        # bench run (or one primed by an earlier run on the same host)
-        # skips straight to steady state.  Cold runs still work: the
-        # stage layout records partial results as compiles land.
-        uid = os.getuid() if hasattr(os, "getuid") else 0
-        cache_root = os.path.join(
-            tempfile.gettempdir(),
-            f"dsql_bench_cache_{platform_choice}_u{uid}")
-        os.makedirs(cache_root, mode=0o700, exist_ok=True)
-        if hasattr(os, "getuid") and os.stat(cache_root).st_uid != uid:
-            # someone else pre-created the path: don't trust (or feed) a
-            # foreign program cache — fall back to a private dir
-            cache_root = tempfile.mkdtemp(prefix="dsql_bench_cache_")
-        env_base = dict(os.environ, BENCH_STAGE="1",
-                        BENCH_DATA_DIR=stage_data_dir,
-                        BENCH_PROGRESS=progress,
-                        BENCH_PLATFORM_CHOICE=platform_choice,
-                        BENCH_SF=str(sf))
-        env_base.setdefault("DSQL_XLA_CACHE",
-                            os.path.join(cache_root, "xla"))
-        env_base.setdefault("DSQL_CAPS_FILE",
-                            os.path.join(cache_root, "caps.json"))
-        for i, stage in enumerate(stage_lists):
-            remaining = budget_end - time.perf_counter()
-            if remaining < 60:
-                print(f"bench: budget exhausted before stage {i}",
-                      file=sys.stderr)
-                stage_meta.append({"stage": i, "error": "budget"})
-                continue
-            # even split of what's left over the remaining stages
-            slice_s = remaining / (len(stage_lists) - i)
-            env = dict(env_base,
-                       BENCH_STAGE_QUERIES=",".join(map(str, stage)))
-            try:
-                proc = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__)],
-                    env=env, timeout=slice_s, capture_output=True, text=True)
-                if proc.returncode != 0:
-                    sys.stderr.write(proc.stderr[-2000:])
-                    stage_meta.append({"stage": i,
-                                       "error": f"rc={proc.returncode}"})
-            except subprocess.TimeoutExpired:
-                print(f"bench: stage {i} ({stage}) exceeded its "
-                      f"{slice_s:.0f}s slice; moving on with partial "
-                      "results", file=sys.stderr)
-                stage_meta.append({"stage": i, "error": "timeout"})
-        return stage_meta
-
-    def collect():
-        times, platforms, mem, cstats = {}, set(), {}, {}
-        load_sec = warmup_sec = 0.0
-        with open(progress) as f:
-            for line in f:
-                rec = json.loads(line)
-                if "q" in rec:
-                    times[rec["q"]] = rec["sec"]
-                    platforms.add(rec["platform"])
-                elif rec.get("stage_done"):
-                    load_sec += rec.get("load_sec", 0)
-                    warmup_sec += rec.get("warmup_sec", 0)
-                    for k, v in (rec.get("device_memory") or {}).items():
-                        mem[k] = max(mem.get(k, 0), v)
-                    for k, v in (rec.get("compiled_stats") or {}).items():
-                        cstats[k] = cstats.get(k, 0) + v
-        return times, platforms, mem, cstats, load_sec, warmup_sec
-
-    stage_meta = run_stages(platform, stages, data_dir,
-                            t_start + TOTAL_BUDGET)
-    times, platforms, mem, cstats, load_sec, warmup_sec = collect()
-    if not times and platform == "default":
-        # the tunnel wedged past the probe: salvage the round on CPU at the
-        # fallback scale factor with its OWN budget rather than record
-        # nothing (the TPU-scale data on a small host would just re-wedge)
-        print("bench: no TPU queries completed; rerunning stages on CPU",
-              file=sys.stderr)
-        sf = float(os.environ.get("BENCH_FALLBACK_SF", "0.1"))
-        salvage_dir = os.path.join(workdir, "data_salvage")
-        os.makedirs(salvage_dir, exist_ok=True)
-        gen2, n_lineitem = _cache_data(sf, salvage_dir)
-        gen_sec += gen2
-        data_dir = salvage_dir
-        salvage = float(os.environ.get("BENCH_SALVAGE_TIMEOUT", "600"))
-        stage_meta += run_stages("cpu", stages, salvage_dir,
-                                 time.perf_counter() + salvage)
-        times, platforms, mem, cstats, load_sec, warmup_sec = collect()
-
-    done = sorted(times)
-    missing = [q for q in qids if q not in times]
-    if not done:
-        print(json.dumps({"metric": "tpch_q1_q22_geomean_wall", "value": -1,
-                          "unit": "s", "vs_baseline": 0,
-                          "detail": {"error": "no queries completed",
-                                     "stages": stage_meta}}))
-        return
-
-    # pandas baseline (single-threaded host — the reference's per-partition
-    # execution substrate), hand-written per query, oracle-validated against
-    # the engine in tests/integration/test_pandas_oracle.py
+    # ---- pandas baseline FIRST (cheap, cannot wedge): single-threaded
+    # host pandas, hand-written per query, oracle-validated against the
+    # engine in tests/integration/test_pandas_oracle.py
     from benchmarks.pandas_tpch import PANDAS_QUERIES
     data = _load_data(data_dir)
-    p_times = {}
-    # the baseline gets a HARD deadline so the metric line always appears
-    # even when the engine stages consumed the whole budget: past it, no
-    # further baseline query starts, and vs_baseline covers the subset
-    p_deadline = time.perf_counter() + float(
-        os.environ.get("BENCH_PANDAS_TIMEOUT", "600"))
-    for qid in done:
-        if time.perf_counter() > p_deadline:
-            break
-        fn = PANDAS_QUERIES.get(qid)
-        if fn is None:
-            continue  # engine-only query: vs_baseline covers `based` anyway
-        best = float("inf")
-        for _ in range(PANDAS_REPS):
-            t0 = time.perf_counter()
-            fn(data)
-            best = min(best, time.perf_counter() - t0)
-            if time.perf_counter() > p_deadline:
+    p_deadline = min(time.monotonic() + PANDAS_BUDGET,
+                     deadline - EMIT_MARGIN - 10)
+    with open(progress, "a") as pf:
+        for qid in qids:
+            if time.monotonic() > p_deadline:
                 break
-        p_times[qid] = best
+            fn = PANDAS_QUERIES.get(qid)
+            if fn is None:
+                continue
+            best = float("inf")
+            try:
+                for _ in range(PANDAS_REPS):
+                    t0 = time.perf_counter()
+                    fn(data)
+                    best = min(best, time.perf_counter() - t0)
+                    if time.monotonic() > p_deadline:
+                        break
+            except Exception as e:
+                # one broken baseline query must not cost the whole bench
+                print(f"bench: pandas baseline q{qid} failed: {e!r}",
+                      file=sys.stderr)
+                continue
+            pf.write(json.dumps({"pq": qid, "sec": round(best, 4)}) + "\n")
+            pf.flush()
+    del data
 
-    geo_e = _geomean([times[q] for q in done])
-    based = [q for q in done if q in p_times]
-    geo_p = _geomean([p_times[q] for q in based]) if based else 0.0
-    ratio = (_geomean([p_times[q] / times[q] for q in based])
-             if based else 0.0)
-    wins = sum(1 for q in based if times[q] < p_times[q])
+    # ---- engine: one child (table transfer is paid once); restart on the
+    # remaining queries only while enough budget remains
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    cache_root = os.path.join(tempfile.gettempdir(),
+                              f"dsql_bench_cache_{platform}_u{uid}")
+    os.makedirs(cache_root, mode=0o700, exist_ok=True)
+    if hasattr(os, "getuid") and os.stat(cache_root).st_uid != uid:
+        # someone else pre-created the path: don't trust (or feed) a
+        # foreign program cache — fall back to a private dir
+        cache_root = tempfile.mkdtemp(prefix="dsql_bench_cache_")
+    env_base = dict(os.environ, BENCH_STAGE="1",
+                    BENCH_DATA_DIR=data_dir,
+                    BENCH_PROGRESS=progress,
+                    BENCH_PLATFORM_CHOICE=platform,
+                    BENCH_SF=str(sf))
+    env_base.setdefault("DSQL_XLA_CACHE", os.path.join(cache_root, "xla"))
+    env_base.setdefault("DSQL_CAPS_FILE",
+                        os.path.join(cache_root, "caps.json"))
 
-    print(json.dumps({
-        "metric": "tpch_q1_q22_geomean_wall",
-        "value": round(geo_e, 4),
-        "unit": "s (geomean over completed queries, lower is better)",
-        "vs_baseline": round(ratio, 3),
-        "detail": {
-            "sf": sf,
-            "platform": "/".join(sorted(platforms)),
-            "lineitem_rows": n_lineitem,
-            "queries": len(done),
-            "missing_queries": missing,
-            "stage_errors": stage_meta,
-            "engine_wins": wins,
-            "engine_sec": {str(k): round(times[k], 4) for k in done},
-            "pandas_sec": {str(k): round(p_times[k], 4) for k in based},
-            "pandas_geomean_sec": round(geo_p, 4),
-            "gen_sec": round(gen_sec, 1),
-            "load_sec": round(load_sec, 1),
-            "warmup_compile_sec": round(warmup_sec, 1),
-            "compiled_stats": cstats,
-            "device_memory": mem,
-        },
-    }))
+    def journal_state():
+        """(measured, warm_failed) query-id sets from the progress file."""
+        got, failed = set(), set()
+        with open(progress) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if "q" in rec:
+                    got.add(rec["q"])
+                elif "warm_fail" in rec:
+                    failed.add(rec["warm_fail"])
+        return got, failed
+
+    attempt = 0
+    max_attempts = int(os.environ.get("BENCH_MAX_CHILDREN", "3"))
+    while attempt < max_attempts:
+        got, failed = journal_state()
+        # a warmup that already failed won't succeed on relaunch — exclude
+        remaining_q = [q for q in qids if q not in got and q not in failed]
+        budget_left = deadline - EMIT_MARGIN - time.monotonic()
+        if not remaining_q or budget_left < MIN_CHILD_BUDGET:
+            break
+        child_deadline_ts = time.time() + budget_left - 10
+        env = dict(env_base,
+                   BENCH_STAGE_QUERIES=",".join(map(str, remaining_q)),
+                   BENCH_CHILD_DEADLINE=str(child_deadline_ts))
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, timeout=budget_left, capture_output=True, text=True)
+            if proc.returncode != 0:
+                sys.stderr.write(proc.stderr[-2000:])
+                state["stage_meta"].append(
+                    {"attempt": attempt, "error": f"rc={proc.returncode}"})
+            # a clean exit does NOT end the loop: the child may have
+            # retired at its deadline or given up on failed warmups — the
+            # while condition relaunches on whatever queries remain, and
+            # exits when none do
+        except subprocess.TimeoutExpired:
+            print(f"bench: engine child {attempt} exceeded its "
+                  f"{budget_left:.0f}s budget; collecting partials",
+                  file=sys.stderr)
+            state["stage_meta"].append({"attempt": attempt,
+                                        "error": "timeout"})
+        attempt += 1
+
+    # salvage INSIDE the budget (the r3 version ran past it, which is what
+    # killed BENCH_r03): if the tunnel passed the probe but every engine
+    # child wedged, record engine-on-CPU numbers on the same data with
+    # whatever budget remains — partial engine numbers beat none
+    # gate on MEASURED queries only: TPU warm failures don't predict CPU
+    # failure, so warm_fail records must not suppress the salvage
+    salvage_left = deadline - EMIT_MARGIN - time.monotonic()
+    if (platform == "default" and salvage_left > MIN_CHILD_BUDGET
+            and not any(q in journal_state()[0] for q in qids)):
+        print("bench: no TPU queries completed; salvaging on CPU within "
+              f"the remaining {salvage_left:.0f}s", file=sys.stderr)
+        env = dict(env_base, BENCH_PLATFORM_CHOICE="cpu",
+                   BENCH_STAGE_QUERIES=",".join(map(str, qids)),
+                   BENCH_CHILD_DEADLINE=str(time.time() + salvage_left - 10))
+        try:
+            subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, timeout=salvage_left,
+                           capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            state["stage_meta"].append({"attempt": "cpu_salvage",
+                                        "error": "timeout"})
+
+    watchdog.cancel()
+    emit_final(reason="complete")
 
 
 if __name__ == "__main__":

@@ -1391,10 +1391,13 @@ class _Tracer:
                     pvalid: jax.Array, ph: jax.Array, bh: jax.Array,
                     exist_test=None):
         """Sorted-probe join, the TPU strategy: sort ONLY the build side's
-        hashes (2-channel argsort at nb rows), binary-search each probe hash
-        (``searchsorted(method='scan')`` — a log2(nb)-step loop, so the HLO
-        is a few ops regardless of size), verify raw keys and fetch build
-        columns by row-id gathers.
+        hashes (2-channel argsort at nb rows), locate each probe hash with
+        ``searchsorted(method='sort')`` — ONE (nb+npr)-row 2-channel sort.
+        The scan method looked cheaper on paper (log2(nb) HLO ops), but on
+        TPU each of its ~21 iterations is an npr-row gather: 2.66 s at
+        SF-1 Q12 shapes vs ~40 ms for the sort method (measured r4, this
+        chip) — the scan was the whole reason join-heavy queries lost to
+        pandas in BENCH_r04 try 1.  Raw keys verify via row-id gathers.
 
         History: r1/r2 shipped a "zero-gather" merge join that moved every
         build column through a variadic sort and an associative carry scan,
@@ -1441,7 +1444,7 @@ class _Tracer:
         self._append_join_flags(
             jt, adj, [rs[1:] != rs[:-1] for rs in raws_sorted])
 
-        pos = jnp.searchsorted(bh_sorted, ph, side="left", method="scan")
+        pos = jnp.searchsorted(bh_sorted, ph, side="left", method="sort")
         in_range = pos < nb
         pos_c = jnp.minimum(pos, nb - 1)
         cand = order[pos_c]

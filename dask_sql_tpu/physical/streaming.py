@@ -22,7 +22,12 @@ and substitute it back.  Split strategies, tried innermost-first:
   * semi/anti key-set: a SEMI/ANTI join whose BUILD (right) side holds the
     chunked scan streams the build as a per-batch DEDUP of the join-key
     (and residual-referenced) columns — semi-join semantics only need key
-    existence, so the join then runs resident against the merged key set.
+    existence, so the join then runs resident against the merged key set;
+  * window regroup: a window with PARTITION BY streams its input per batch
+    to host, hash-buckets the rows on the partition keys (whole partitions
+    land in one bucket), and runs the window resident per equal-capacity
+    bucket — one compile, N-1 cache hits; a table-sized window output
+    re-registers as a chunked source so streaming continues above it.
 
 Joins on a streamed path keep the build (resident) side fixed: subtrees
 not containing the chunked scan are materialized ONCE into temp tables and
@@ -41,10 +46,10 @@ mesh and the per-batch compiled program executes as a GSPMD program — the
 streaming and distributed axes compose (the reference's model is
 out-of-core AND distributed at once, input_utils/convert.py:38-62).
 
-Plans outside every strategy (a window directly over the chunked scan, no
-aggregate/limit split, chunked on the NULL-extended side of an outer join)
-raise ``StreamingUnsupported`` with a reason — never a silent wrong answer
-on schema stubs.
+Plans outside every strategy (a window without PARTITION BY over the
+chunked scan, no aggregate/limit split, chunked on the NULL-extended side
+of an outer join) raise ``StreamingUnsupported`` with a reason — never a
+silent wrong answer on schema stubs.
 """
 from __future__ import annotations
 
@@ -57,8 +62,8 @@ import numpy as np
 from ..datacontainer import TableEntry
 from ..plan.nodes import (
     AggCall, Field, LogicalAggregate, LogicalFilter, LogicalJoin,
-    LogicalProject, LogicalSort, LogicalTableScan, RelNode, RexCall,
-    RexInputRef,
+    LogicalProject, LogicalSort, LogicalTableScan, LogicalWindow, RelNode,
+    RexCall, RexInputRef,
 )
 from ..table import Table
 from ..types import BIGINT, DOUBLE
@@ -248,6 +253,13 @@ def _stream_partial_plans(subtree: RelNode, scan: LogicalTableScan,
                     f"{jt} join with the chunked table on the NULL-extended "
                     "side cannot stream (every build row must see all probe "
                     "rows)")
+        if isinstance(rel, LogicalWindow):
+            # a window executed per batch sees only that batch's slice of
+            # each partition — _find_split handles windows with their own
+            # regrouping split, so one on the streamed path here is a plan
+            # shape that must not run (it would be silently wrong)
+            raise StreamingUnsupported(
+                "window function on the streamed path cannot run per batch")
         return rel.with_inputs([rebuild(i) for i in rel.inputs])
 
     return rebuild(subtree)
@@ -632,6 +644,143 @@ def _stream_topk_split(sort: LogicalSort, scan, path, source,
     return sort, final
 
 
+def _bucket_ids(cols, keys: List[int], n_buckets: int) -> np.ndarray:
+    """FNV-style row hash of the partition-key columns (host numpy).
+    String columns hash their dictionary CODES — all batches share the
+    global dictionaries (io/chunked.py invariant), so equal values have
+    equal codes; floats canonicalize NaN into its own channel."""
+    total = len(cols[0][0]) if cols else 0
+    if n_buckets <= 1:
+        return np.zeros(total, dtype=np.int64)
+    h = np.zeros(total, dtype=np.uint64)
+    P = np.uint64(1099511628211)
+    NAN_SALT = np.uint64(0x9E3779B97F4A7C15)
+    for k in keys:
+        data, mask, _, _ = cols[k]
+        if data.dtype.kind == "f":
+            isnan = np.isnan(data)
+            canon = np.where(isnan, 0.0, data).astype(np.float64)
+            part = canon.view(np.uint64) ^ (isnan.astype(np.uint64)
+                                            * NAN_SALT)
+        else:
+            part = data.astype(np.int64, copy=False).view(np.uint64)
+        if mask is not None:
+            # data under a NULL slot is arbitrary in this engine (gathers
+            # leave garbage there; ops/kernels.py key_parts sentinels it
+            # the same way) — canonicalize so every NULL key hashes alike
+            part = np.where(mask, part, np.uint64(0))
+            h = (h ^ mask.astype(np.uint64)) * P
+        h = (h ^ part) * P
+    return (h % np.uint64(n_buckets)).astype(np.int64)
+
+
+def _stream_window_split(win: LogicalWindow, scan, path, source, context):
+    """Window over a chunked scan: stream the below-window subtree per
+    batch, regroup the (host) rows into hash buckets of the PARTITION BY
+    keys, and run the window resident per bucket — every partition lands
+    wholly inside one bucket, so any ORDER BY / frame inside it is exact
+    (the reference runs windows per partition over partitioned input by
+    construction, window.py:207-414 + input_utils/convert.py:38-62).
+    Buckets pad to one shared capacity => one compile, N-1 cache hits."""
+    common: Optional[set] = None
+    for call in win.calls:
+        if not call.partition:
+            raise StreamingUnsupported(
+                "window without PARTITION BY over a chunked table needs the "
+                "whole input resident at once")
+        common = (set(call.partition) if common is None
+                  else common & set(call.partition))
+    if not common:
+        raise StreamingUnsupported(
+            "window calls share no PARTITION BY column to regroup on")
+    keys = sorted(common)
+
+    below = _stream_partial_plans(win.inputs[0], scan, path, context)
+    # the bare below-window subtree per batch: _materialize compacts
+    # padding, so host partials hold exactly the real rows
+    partials = _run_batches(below, source, context)
+    names, cols = _concat_host(partials)
+    total = len(cols[0][0]) if cols else 0
+
+    n_buckets = max(1, -(-total // max(int(source.batch_rows), 1)))
+    ids = _bucket_ids(cols, keys, n_buckets)
+    # one stable argsort + boundary search, not an O(rows x buckets) scan
+    order = np.argsort(ids, kind="stable")
+    bounds = np.searchsorted(ids[order], np.arange(n_buckets + 1))
+    selections = [order[bounds[b]:bounds[b + 1]]
+                  for b in range(n_buckets) if bounds[b] < bounds[b + 1]]
+    if not selections:
+        selections = [np.arange(0)]
+    cap = max(len(s) for s in selections)
+
+    import jax.numpy as jnp
+
+    from ..table import Column as _Col
+
+    fields = [Field(f.name, f.stype) for f in below.schema]
+    batch_scan = LogicalTableScan(schema_name=STREAM_SCHEMA,
+                                  table_name=BATCH_TABLE, schema=fields)
+    win_plan = LogicalWindow(input=batch_scan, calls=list(win.calls),
+                             schema=list(win.schema))
+
+    out_parts: List[tuple] = []
+    for sel in selections:
+        pad = cap - len(sel)
+        bcols = []
+        for data, mask, stype, d in cols:
+            bd = data[sel]
+            bm = mask[sel] if mask is not None else None
+            if pad:
+                bd = np.concatenate([bd, np.zeros(pad, dtype=bd.dtype)])
+                if bm is not None:
+                    bm = np.concatenate([bm, np.zeros(pad, dtype=bool)])
+            bcols.append(_Col(jnp.asarray(bd), stype,
+                              None if bm is None else jnp.asarray(bm), d))
+        btable = Table(list(names), bcols)
+        # ALWAYS pass row_valid: the compiled-program cache keys on its
+        # presence, so the one full (pad==0) bucket would otherwise trace
+        # a second program — a second multi-minute compile over the tunnel
+        row_valid = jnp.arange(cap) < len(sel)
+        _set_batch_entry(context, btable, row_valid)
+        result = _run_resident(win_plan, context)
+        out_parts.append(_host_partial(result))
+        logger.debug("window bucket -> %d rows", result.num_rows)
+
+    out_names, out_cols = _concat_host(out_parts)
+    if _partial_bytes(out_parts) <= PARTIAL_BYTES_BUDGET:
+        tmp = _retype(_host_cols_to_temp(out_names, out_cols, context),
+                      win.schema)
+        return win, tmp
+    # table-sized window output: re-register as a CHUNKED source so the
+    # strategies above the window keep streaming instead of materializing
+    from ..io.chunked import ChunkedSource
+
+    br = max(int(source.batch_rows), 1)
+    out_total = len(out_cols[0][0]) if out_cols else 0
+    batches = []
+    for s0 in range(0, max(out_total, 1), br):
+        batches.append([(data[s0:s0 + br],
+                         None if mask is None else mask[s0:s0 + br])
+                        for data, mask, _, _ in out_cols])
+    src = ChunkedSource([f"c{i}" for i in range(len(out_cols))],
+                        [f.stype for f in win.schema],
+                        [d for _, _, _, d in out_cols],
+                        batches, out_total, br)
+    if STREAM_SCHEMA not in context.schema:
+        context.create_schema(STREAM_SCHEMA)
+    _tmp_counter[0] += 1
+    name = f"t{_tmp_counter[0]}"
+    context.schema[STREAM_SCHEMA].tables[name] = TableEntry(
+        table=src.schema_table(), chunked=src)
+    # sanitized c{i} names on BOTH the source and the scan: downstream
+    # nodes reference ordinals, and the executor matches scan fields to
+    # table columns by name (same contract as _register_temp)
+    return win, LogicalTableScan(
+        schema_name=STREAM_SCHEMA, table_name=name,
+        schema=[Field(f"c{i}", f.stype)
+                for i, f in enumerate(win.schema)])
+
+
 def _semi_build_refs(join: LogicalJoin) -> Optional[List[int]]:
     """Right-side column indices the SEMI/ANTI join condition references,
     or None when the condition has a shape the key-set rewrite can't remap."""
@@ -721,7 +870,10 @@ def _find_split(plan: RelNode, scan: LogicalTableScan, context):
             "stream; materialize the subquery first")
     # innermost-first: walk up from the scan
     for node in reversed(path[:-1]):
-        if isinstance(node, LogicalAggregate):
+        if isinstance(node, LogicalWindow):
+            if len(_chunked_scans(node, context)) == 1:
+                return "window", node, path
+        elif isinstance(node, LogicalAggregate):
             if len(_chunked_scans(node, context)) == 1:
                 return "agg", node, path
         elif isinstance(node, LogicalSort) and node.limit is not None:
@@ -799,6 +951,9 @@ def _lower_chunked(plan: RelNode, context) -> RelNode:
                 elif kind == "topk":
                     old, new = _stream_topk_split(node, scan, path,
                                                   source, context)
+                elif kind == "window":
+                    old, new = _stream_window_split(node, scan, path,
+                                                    source, context)
                 else:
                     old, new = _stream_keyset_split(node, scan, source,
                                                     context)

@@ -238,3 +238,99 @@ def test_chunked_inside_scalar_subquery(tpch_pair):
          "(SELECT AVG(l_suppkey) FROM lineitem)")
     _assert_frames(plain.sql(q, return_futures=False),
                    ck.sql(q, return_futures=False))
+
+
+# ---------------------------------------------------------------------------
+# out-of-core window functions (VERDICT r3 item 5): a window with
+# PARTITION BY streams its input per batch, regroups rows into hash
+# buckets of the partition keys, and runs the window resident per bucket
+# (physical/streaming.py _stream_window_split).  The reference runs
+# windows over partitioned input by construction
+# (/root/reference/dask_sql/physical/rel/logical/window.py:207-414).
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def window_pair():
+    rng = np.random.RandomState(7)
+    n = 3000
+    df = pd.DataFrame({
+        "k": rng.randint(0, 11, n),
+        "s": rng.choice(["a", "b", "c", None], n),
+        "v": np.round(rng.randn(n), 4),
+        "w": rng.randint(-50, 50, n).astype(np.float64),
+    })
+    plain = Context()
+    plain.create_table("t", df)
+    ck = Context()
+    ck.create_table("t", df, chunked=True, batch_rows=256)
+    return plain, ck
+
+
+WINDOW_QUERIES = {
+    "row_number": (
+        "SELECT k, v, ROW_NUMBER() OVER (PARTITION BY k ORDER BY v, w) AS rn "
+        "FROM t ORDER BY k, rn LIMIT 200"),
+    "sum_over": (
+        "SELECT k, SUM(v) OVER (PARTITION BY k ORDER BY v, w) AS c "
+        "FROM t ORDER BY k, c LIMIT 200"),
+    "rows_frame": (
+        "SELECT k, SUM(w) OVER (PARTITION BY k ORDER BY v, w "
+        "ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) AS f "
+        "FROM t ORDER BY k, f LIMIT 200"),
+    "null_partition_keys": (
+        "SELECT s, COUNT(*) OVER (PARTITION BY s) AS n, "
+        "ROW_NUMBER() OVER (PARTITION BY s ORDER BY v, w) AS rn "
+        "FROM t ORDER BY s, rn LIMIT 200"),
+    "agg_above_window": (
+        "SELECT k, MAX(rn) AS m, SUM(rs) AS t FROM (SELECT k, "
+        "ROW_NUMBER() OVER (PARTITION BY k ORDER BY v, w) AS rn, "
+        "SUM(v) OVER (PARTITION BY k) AS rs FROM t) x GROUP BY k "
+        "ORDER BY k"),
+}
+
+
+@pytest.mark.parametrize("name", sorted(WINDOW_QUERIES))
+def test_window_chunked_matches_resident(window_pair, name):
+    plain, ck = window_pair
+    q = WINDOW_QUERIES[name]
+    _assert_frames(plain.sql(q, return_futures=False),
+                   ck.sql(q, return_futures=False))
+
+
+def test_window_output_reregisters_as_chunked(window_pair, monkeypatch):
+    """A window output larger than the partial budget re-registers as a
+    chunked source (sliced back into batch_rows batches) so the aggregate
+    above it KEEPS streaming instead of materializing a table-sized temp."""
+    from dask_sql_tpu.physical import streaming as sm
+
+    plain, ck = window_pair
+    monkeypatch.setattr(sm, "PARTIAL_BYTES_BUDGET", 1024)
+    q = WINDOW_QUERIES["agg_above_window"]
+    _assert_frames(plain.sql(q, return_futures=False),
+                   ck.sql(q, return_futures=False))
+
+
+def test_window_without_partition_rejected(window_pair):
+    _, ck = window_pair
+    with pytest.raises(StreamingUnsupported, match="PARTITION BY"):
+        ck.sql("SELECT k, SUM(v) OVER (ORDER BY v) AS c FROM t")
+
+
+def test_window_streaming_composes_with_mesh():
+    from dask_sql_tpu.parallel.mesh import default_mesh
+
+    mesh = default_mesh()
+    if mesh.devices.size < 2:
+        pytest.skip("needs a multi-device mesh")
+    rng = np.random.RandomState(11)
+    n = 1200
+    df = pd.DataFrame({"k": rng.randint(0, 5, n),
+                       "v": np.round(rng.randn(n), 4)})
+    plain = Context()
+    plain.create_table("t", df)
+    dist = Context(mesh=mesh)
+    dist.create_table("t", df, chunked=True, batch_rows=256)
+    q = ("SELECT k, MAX(rn) AS m FROM (SELECT k, ROW_NUMBER() OVER "
+         "(PARTITION BY k ORDER BY v) AS rn FROM t) x GROUP BY k ORDER BY k")
+    _assert_frames(plain.sql(q, return_futures=False),
+                   dist.sql(q, return_futures=False))
