@@ -276,9 +276,19 @@ def main():
     state = {
         "progress": None, "qids": [], "sf": SF, "n_lineitem": 0,
         "gen_sec": 0.0, "platform_choice": "?", "stage_meta": [],
-        "emitted": False,
+        "emitted": False, "child": None,
     }
     emit_lock = threading.Lock()
+
+    def _kill_child():
+        """Emergency exits must not orphan an engine child wedged in a
+        tunnel compile — it would hold the TPU and poison the next run."""
+        proc = state.get("child")
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.kill()
+            except OSError:
+                pass
 
     def emit_final(reason=None):
         """Idempotent: compute the metric line from the progress journal and
@@ -397,6 +407,7 @@ def main():
         print(json.dumps(out), flush=True)
 
     def _die(signum, frame):
+        _kill_child()
         if state.get("emitting_thread") == threading.get_ident():
             # the signal interrupted our own in-progress emission: mark it
             # and let the print finish (the finally above exits for us)
@@ -421,7 +432,8 @@ def main():
     # metric line prints no matter where time runs out
     watchdog = threading.Timer(
         max(deadline - EMIT_MARGIN - time.monotonic(), 1.0),
-        lambda: (emit_final(reason="watchdog"), os._exit(0)))
+        lambda: (emit_final(reason="watchdog"), _kill_child(),
+                 os._exit(0)))
     watchdog.daemon = True
     watchdog.start()
 
@@ -528,12 +540,15 @@ def main():
         env = dict(env_base,
                    BENCH_STAGE_QUERIES=",".join(map(str, remaining_q)),
                    BENCH_CHILD_DEADLINE=str(child_deadline_ts))
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        state["child"] = proc  # emergency exits kill it (no orphans)
         try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, timeout=budget_left, capture_output=True, text=True)
+            _, err = proc.communicate(timeout=budget_left)
             if proc.returncode != 0:
-                sys.stderr.write(proc.stderr[-2000:])
+                sys.stderr.write(err[-2000:])
                 state["stage_meta"].append(
                     {"attempt": attempt, "error": f"rc={proc.returncode}"})
             # a clean exit does NOT end the loop: the child may have
@@ -541,11 +556,14 @@ def main():
             # while condition relaunches on whatever queries remain, and
             # exits when none do
         except subprocess.TimeoutExpired:
+            proc.kill()
             print(f"bench: engine child {attempt} exceeded its "
                   f"{budget_left:.0f}s budget; collecting partials",
                   file=sys.stderr)
             state["stage_meta"].append({"attempt": attempt,
                                         "error": "timeout"})
+        finally:
+            state["child"] = None
         attempt += 1
 
     # salvage INSIDE the budget (the r3 version ran past it, which is what
@@ -562,13 +580,19 @@ def main():
         env = dict(env_base, BENCH_PLATFORM_CHOICE="cpu",
                    BENCH_STAGE_QUERIES=",".join(map(str, qids)),
                    BENCH_CHILD_DEADLINE=str(time.time() + salvage_left - 10))
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        state["child"] = proc
         try:
-            subprocess.run([sys.executable, os.path.abspath(__file__)],
-                           env=env, timeout=salvage_left,
-                           capture_output=True, text=True)
+            proc.communicate(timeout=salvage_left)
         except subprocess.TimeoutExpired:
+            proc.kill()
             state["stage_meta"].append({"attempt": "cpu_salvage",
                                         "error": "timeout"})
+        finally:
+            state["child"] = None
 
     watchdog.cancel()
     emit_final(reason="complete")

@@ -77,25 +77,20 @@ def segmented_scan(x: jax.Array, starts: jax.Array, combine) -> jax.Array:
     return out
 
 
-def window_frame_sums(x: jax.Array, seg_start: jax.Array, seg_end: jax.Array,
-                      lo: Optional[int], hi: Optional[int]):
-    """Moving SUM/COUNT over ROWS frames using prefix sums.
+def window_frame_sums(x: jax.Array, start: jax.Array, end: jax.Array):
+    """Moving SUM/COUNT over per-row frame bounds using one prefix sum.
 
-    lo/hi are row offsets relative to current (negative = preceding); None =
-    unbounded on that side. seg_start/seg_end are PER-ROW positions of the
-    row's segment bounds in sorted order.
+    ``start``/``end`` are PER-ROW inclusive positions in sorted order
+    (already clipped to the row's segment); an empty frame is
+    ``end < start`` and sums to 0.
     """
     n = x.shape[0]
     prefix = jnp.cumsum(x)
-    idx = jnp.arange(n)
-    start = seg_start if lo is None else jnp.maximum(idx + lo, seg_start)
-    end = seg_end if hi is None else jnp.minimum(idx + hi, seg_end)
-    end = jnp.minimum(end, n - 1)
-    start = jnp.maximum(start, 0)
-    upper = prefix[end]
-    lower = jnp.where(start > 0, prefix[jnp.maximum(start - 1, 0)], 0)
-    empty = end < start
-    return jnp.where(empty, 0, upper - lower)
+    end_c = jnp.clip(end, 0, n - 1)
+    start_c = jnp.clip(start, 0, n - 1)
+    upper = prefix[end_c]
+    lower = jnp.where(start_c > 0, prefix[jnp.maximum(start_c - 1, 0)], 0)
+    return jnp.where(end < start, 0, upper - lower)
 
 
 def compute_window(table: Table, op: str, arg_cols: List[int],
@@ -189,8 +184,113 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
     seg_end = jnp.flip(segmented_scan(jnp.flip(pos), ends_flags, jnp.maximum))
     row_in_seg = pos - seg_start
 
-    # frame bounds as offsets
-    lo_off, hi_off = _frame_offsets(op, frame, bool(order_keys))
+    # peer-group (tie) bounds under the ORDER BY keys: SQL's default frame
+    # and RANGE CURRENT ROW are PEER-inclusive (PostgreSQL/SQLite agree;
+    # treating them as row bounds was the r4 oracle-caught bug)
+    if order_keys:
+        tie_start = segmented_scan(jnp.where(tie | starts, pos, -1), starts,
+                                   jnp.maximum)
+        is_last_of_tie = jnp.concatenate([tie[1:] | starts[1:],
+                                          jnp.ones(1, bool)])
+        tie_end = _backward_fill_positions(pos, is_last_of_tie, seg_end)
+    else:
+        tie_start, tie_end = seg_start, seg_end
+
+    def _value_bound(delta: float, side: str) -> jax.Array:
+        """RANGE <offset> PRECEDING/FOLLOWING: positions by ORDER BY value.
+        Works on the TRANSFORMED sort channel (DESC already negated), so
+        the frame is uniformly [t-delta_lo, t+delta_hi] in sorted space; a
+        per-segment float offset larger than the global value span makes
+        one globally sorted composite, so a single searchsorted respects
+        segment boundaries by construction."""
+        if len(order_keys) != 1:
+            raise NotImplementedError(
+                "RANGE offset frame requires exactly one ORDER BY key")
+        kcol = table.columns[order_keys[0][0]]
+        if kcol.mask is not None:
+            raise NotImplementedError(
+                "RANGE offset frame over a nullable ORDER BY key")
+        t = keys_sorted[n_seg_ops]
+        if not (jnp.issubdtype(t.dtype, jnp.integer)
+                or jnp.issubdtype(t.dtype, jnp.floating)):
+            raise NotImplementedError(
+                "RANGE offset frame requires a numeric ORDER BY key")
+        tf = t.astype(jnp.float64)
+        # real = finite values of VALID rows: compiled-mode padding rows
+        # carry arbitrary gather garbage, and NaN order keys sort last
+        # within their segment — either would inflate the composite offset
+        # (destroying float64 precision for real rows) or break the global
+        # sortedness searchsorted requires.  Replace both with max_real+1:
+        # still sorted, real rows' bounds unaffected up to the documented
+        # edge that a NaN "peer of NaN" may absorb near-max neighbors.
+        # (Limitation: int64 keys above 2^53 lose ULPs here — ns-epoch
+        # timestamps order correctly but offset frames on them are
+        # approximate.)
+        real = jnp.isfinite(tf)
+        if row_valid is not None:
+            real = real & (keys_sorted[0] == 0)  # invalid rows sort last
+        any_real = real.any()
+        lo_r = jnp.min(jnp.where(real, tf, jnp.inf))
+        hi_r = jnp.max(jnp.where(real, tf, -jnp.inf))
+        lo_r = jnp.where(any_real, lo_r, 0.0)
+        hi_r = jnp.where(any_real, hi_r, 0.0)
+        # -inf sorted first in its segment -> clamp low; +inf/NaN/garbage
+        # sorted last -> clamp high: per-segment order is preserved
+        neg = jnp.isneginf(tf)
+        tf_c = jnp.where(real, tf,
+                         jnp.where(neg, lo_r - 1.0, hi_r + 1.0))
+        span = hi_r - lo_r + 2.0
+        big = span + jnp.float64(abs(delta) + 1.0)
+        seg_id = jnp.cumsum(starts.astype(jnp.int64)).astype(jnp.float64)
+        g = tf_c + seg_id * big
+        method = "sort" if on_tpu else "scan"
+        if side == "start":
+            return jnp.searchsorted(g, g + delta, side="left", method=method)
+        return jnp.searchsorted(g, g + delta, side="right",
+                                method=method) - 1
+
+    def _resolve_bound(bound, which: str, kind: str):
+        """(positions, kind) for one frame bound; kind in
+        'unb' | 'fixed' (row offset) | 'var' (peer/value positions)."""
+        tag, nval = bound
+        if tag == "UNBOUNDED_PRECEDING":
+            return seg_start, "unb"
+        if tag == "UNBOUNDED_FOLLOWING":
+            return seg_end, "unb"
+        if tag == "CURRENT":
+            if kind == "RANGE":
+                # peers of the current row; with no ORDER BY every
+                # partition row is a peer (tie bounds = segment bounds)
+                return (tie_start if which == "lo" else tie_end), "var"
+            return pos, "fixed"
+        delta = -float(nval) if tag == "PRECEDING" else float(nval)
+        if kind == "ROWS":
+            off = int(delta)
+            arr = pos + off
+            arr = (jnp.maximum(arr, seg_start) if which == "lo"
+                   else jnp.minimum(arr, seg_end))
+            return arr, "fixed"
+        return _value_bound(delta, "start" if which == "lo" else "end"), "var"
+
+    # resolve the frame to per-row inclusive [fstart, fend] positions
+    if frame is None:
+        if order_keys and op not in ("ROW_NUMBER", "RANK", "DENSE_RANK"):
+            # SQL default: RANGE UNBOUNDED PRECEDING .. CURRENT ROW
+            fstart, lo_kind = seg_start, "unb"
+            fend, hi_kind = tie_end, "var"
+        else:
+            fstart, lo_kind = seg_start, "unb"
+            fend, hi_kind = seg_end, "unb"
+        lo_off, hi_off = None, None
+    else:
+        kind = frame[0]
+        fstart, lo_kind = _resolve_bound(frame[1], "lo", kind)
+        fend, hi_kind = _resolve_bound(frame[2], "hi", kind)
+        # row offsets kept for the MIN/MAX fixed-width fast path
+        lo_off = (int(-frame[1][1]) if frame[1][0] == "PRECEDING"
+                  else int(frame[1][1]) if frame[1][0] == "FOLLOWING" else 0)
+        hi_off = (int(-frame[2][1]) if frame[2][0] == "PRECEDING"
+                  else int(frame[2][1]) if frame[2][0] == "FOLLOWING" else 0)
 
     def scatter_back(sorted_vals, mask_sorted=None):
         # un-sort to original row order: payload sort on TPU, argsort +
@@ -212,10 +312,8 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
         return scatter_back(row_in_seg + 1)
 
     if op in ("RANK", "DENSE_RANK", "PERCENT_RANK", "CUME_DIST"):
-        # rank = position of the first row of the current tie group:
-        # propagate the last tie/segment start forward within the segment
-        tie_start = segmented_scan(jnp.where(tie | starts, pos, -1), starts,
-                                   jnp.maximum)
+        # rank = position of the first row of the current tie group
+        # (tie_start/tie_end hoisted above, shared with frame resolution)
         rank = tie_start - seg_start + 1
         if op == "RANK":
             return scatter_back(rank)
@@ -226,8 +324,6 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
         if op == "CUME_DIST":
             seg_len = seg_end - seg_start + 1
             # number of rows with order key <= current = end of tie group
-            is_last_of_tie = jnp.concatenate([tie[1:] | starts[1:], jnp.ones(1, bool)])
-            tie_end = _backward_fill_positions(pos, is_last_of_tie, seg_end)
             return scatter_back((tie_end - seg_start + 1) / seg_len)
         # DENSE_RANK: count of tie-group starts up to here within segment
         dr = segmented_cumsum((tie | starts).astype(jnp.int64), starts)
@@ -257,22 +353,25 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
         return out
 
     if op in ("FIRST_VALUE", "LAST_VALUE", "NTH_VALUE"):
+        # frame-aware (the standard applies the window frame to these):
+        # FIRST_VALUE = first frame row, LAST_VALUE = last frame row —
+        # under the default frame that is the segment start / the current
+        # row's LAST PEER (not the current row: ties share a value)
         col = sorted_arg()
+        in_frame = fend >= fstart
         if op == "FIRST_VALUE":
-            src = seg_start
+            src = fstart
         elif op == "LAST_VALUE":
-            # default frame = up to CURRENT ROW when ORDER BY present
-            if order_keys and frame is None:
-                src = pos
-            else:
-                src = seg_end
+            src = fend
         else:
             k = int(np.asarray(table.columns[arg_cols[1]].data)[0])
-            src = seg_start + (k - 1)
-            src = jnp.minimum(src, seg_end)
+            src = fstart + (k - 1)
+            in_frame = in_frame & (src <= fend)
+            src = jnp.minimum(src, jnp.maximum(fend, fstart))
+        src = jnp.clip(src, 0, n - 1)
         gathered = col.take(src)
-        out = scatter_back(gathered.data,
-                           gathered.mask if gathered.mask is not None else None)
+        m = gathered.valid_mask() & in_frame
+        out = scatter_back(gathered.data, m)
         if col.stype.is_string:
             return Column(out.data.astype(jnp.int32), stype, out.mask, col.dictionary)
         return out
@@ -284,7 +383,7 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
             x = col.valid_mask().astype(jnp.int64)
         else:
             x = jnp.ones(n, dtype=jnp.int64)
-        out = window_frame_sums(x, seg_start, seg_end, lo_off, hi_off)
+        out = window_frame_sums(x, fstart, fend)
         return scatter_back(out)
 
     if op in ("SUM", "$SUM0", "AVG"):
@@ -295,9 +394,8 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
             data = data.astype(jnp.int64)
         else:
             data = data.astype(jnp.float64)
-        s = window_frame_sums(data, seg_start, seg_end, lo_off, hi_off)
-        c = window_frame_sums(valid.astype(jnp.int64), seg_start, seg_end,
-                              lo_off, hi_off)
+        s = window_frame_sums(data, fstart, fend)
+        c = window_frame_sums(valid.astype(jnp.int64), fstart, fend)
         if op == "AVG":
             out = s / jnp.maximum(c, 1)
             return scatter_back(out, (c > 0))
@@ -317,21 +415,23 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
             sentinel = jnp.inf if op == "MIN" else -jnp.inf
         x = jnp.where(valid, data, sentinel)
         combine = jnp.minimum if op == "MIN" else jnp.maximum
-        if lo_off is None and hi_off == 0:
-            out = segmented_scan(x, starts, combine)
-        elif lo_off is None and hi_off is None:
+        if lo_kind == "unb" and hi_kind == "unb":
             # whole partition: segment reduce then broadcast
             total = segmented_scan(x, starts, combine)
             out = total[seg_end]
-        elif lo_off is None:
-            # UNBOUNDED PRECEDING .. k: prefix scan + one gather (an O(n)
-            # shift loop here would build an O(n^2) trace)
+        elif lo_kind == "unb":
+            # UNBOUNDED PRECEDING .. bound: prefix scan + one gather (an
+            # O(n) shift loop here would build an O(n^2) trace); fend may
+            # be peer- or value-based — the gather covers all cases
             fwd = segmented_scan(x, starts, combine)
-            out = fwd[jnp.clip(pos + hi_off, seg_start, seg_end)]
-        elif hi_off is None:
-            # k .. UNBOUNDED FOLLOWING: suffix scan + one gather
+            out = fwd[jnp.clip(fend, seg_start, seg_end)]
+        elif hi_kind == "unb":
+            # bound .. UNBOUNDED FOLLOWING: suffix scan + one gather
             bwd = jnp.flip(segmented_scan(jnp.flip(x), ends_flags, combine))
-            out = bwd[jnp.clip(pos + lo_off, seg_start, seg_end)]
+            out = bwd[jnp.clip(fstart, seg_start, seg_end)]
+        elif lo_kind == "var" or hi_kind == "var":
+            raise NotImplementedError(
+                "MIN/MAX over a RANGE frame bounded on both sides")
         else:
             # bounded frame: van Herk two-scan sliding window — O(n) for any
             # frame width w. Width-w blocks get prefix/suffix scans; an
@@ -363,13 +463,12 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
                             jnp.where(low_clip, cum,
                                       jnp.where(high_clip, suf, vh)))
             in_frame_cnt = window_frame_sums(valid.astype(jnp.int64),
-                                             seg_start, seg_end, lo_off, hi_off)
+                                             fstart, fend)
             m = in_frame_cnt > 0
             if col.stype.is_string:
                 return _ranks_to_string(scatter_back(out, m), table.columns[arg_cols[0]], stype)
             return scatter_back(out, m)
-        c = window_frame_sums(valid.astype(jnp.int64), seg_start, seg_end,
-                              lo_off, hi_off)
+        c = window_frame_sums(valid.astype(jnp.int64), fstart, fend)
         m = c > 0
         if col.stype.is_string:
             return _ranks_to_string(scatter_back(out, m),
@@ -394,31 +493,6 @@ def _ranks_to_string(rank_col: Column, orig: Column, stype: SqlType) -> Column:
     safe = jnp.clip(rank_col.data.astype(jnp.int64), 0, len(order) - 1)
     codes = jnp.take(inv, safe).astype(jnp.int32)
     return Column(codes, stype, rank_col.mask, orig.dictionary)
-
-
-def _frame_offsets(op: str, frame, has_order: bool):
-    """Map a frame spec to (lo, hi) row offsets (None = unbounded)."""
-    if frame is None:
-        if has_order and op not in ("ROW_NUMBER", "RANK", "DENSE_RANK"):
-            return None, 0          # default: UNBOUNDED PRECEDING .. CURRENT
-        return None, None           # whole partition
-    kind, lo, hi = frame
-    def conv(b, default):
-        tag, n = b
-        if tag == "UNBOUNDED_PRECEDING":
-            return None
-        if tag == "UNBOUNDED_FOLLOWING":
-            return None
-        if tag == "CURRENT":
-            return 0
-        if tag == "PRECEDING":
-            return -int(n)
-        return int(n)
-    lo_v = conv(lo, None)
-    hi_v = conv(hi, 0)
-    if lo[0] == "UNBOUNDED_FOLLOWING":
-        lo_v = None
-    return lo_v, hi_v
 
 
 def _backward_fill_positions(pos, is_last, seg_end):

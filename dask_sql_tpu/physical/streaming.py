@@ -659,7 +659,9 @@ def _bucket_ids(cols, keys: List[int], n_buckets: int) -> np.ndarray:
         data, mask, _, _ = cols[k]
         if data.dtype.kind == "f":
             isnan = np.isnan(data)
-            canon = np.where(isnan, 0.0, data).astype(np.float64)
+            # + 0.0 folds -0.0 into +0.0 — the resident engine's key_parts
+            # canonicalization groups the two zeros as one partition
+            canon = np.where(isnan, 0.0, data).astype(np.float64) + 0.0
             part = canon.view(np.uint64) ^ (isnan.astype(np.uint64)
                                             * NAN_SALT)
         else:

@@ -30,9 +30,10 @@ python -m pytest tests/integration/test_compiled.py \
 python -m pytest tests/integration/test_tpch.py \
                  tests/integration/test_pandas_oracle.py -q
 
-echo "=== [3/4] mesh suites (8 virtual devices) ==="
+echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
-                 tests/integration/test_tpch_mesh.py -q
+                 tests/integration/test_tpch_mesh.py \
+                 tests/integration/test_multihost.py -q
 
 echo "=== [4/4] bare install smoke ==="
 TMPDIR=$(mktemp -d)

@@ -1,0 +1,337 @@
+"""PostgreSQL-class differential coverage (randomized, seeded).
+
+The reference double-oracles the tricky query classes against a dockerized
+PostgreSQL (/root/reference/tests/integration/fixtures.py:188-288,
+test_postgres.py:9-44) because SQLite's loose typing hides NULL-ordering,
+decimal, interval and frame edge cases.  No docker exists in this image, so
+these tests close the same classes two ways:
+
+- sqlite3 >= 3.40 DOES implement window frames (ROWS/RANGE with offsets),
+  ``NULLS FIRST/LAST`` on ORDER BY, and correlated subqueries with
+  standard semantics — those classes stay differential (eq_sqlite);
+- INTERVAL/date arithmetic and DECIMAL cast chains, where sqlite has no
+  real types, are GOLDEN tests: expectations computed with pandas /
+  python decimal following PostgreSQL semantics.
+"""
+import datetime
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.conftest import assert_eq, eq_sqlite, make_rand_df
+
+from dask_sql_tpu import Context
+
+
+# ---------------------------------------------------------------------------
+# NULLS FIRST / NULLS LAST x ASC / DESC (reference: postgres sort tests)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("direction", ["ASC", "DESC"])
+@pytest.mark.parametrize("nulls", ["FIRST", "LAST"])
+def test_order_nulls_directions_rand(direction, nulls):
+    a = make_rand_df(40, a=(int, 8), b=(float, 8), c=(str, 8))
+    eq_sqlite(
+        f"SELECT * FROM a ORDER BY a {direction} NULLS {nulls}, "
+        f"b {direction} NULLS {nulls}, c LIMIT 25",
+        check_row_order=True, a=a)
+
+
+def test_order_mixed_nulls_directions_rand():
+    a = make_rand_df(40, a=(int, 10), b=(float, 10))
+    eq_sqlite(
+        "SELECT * FROM a ORDER BY a ASC NULLS FIRST, b DESC NULLS LAST "
+        "LIMIT 30", check_row_order=True, a=a)
+    eq_sqlite(
+        "SELECT * FROM a ORDER BY a DESC NULLS FIRST, b ASC NULLS LAST "
+        "LIMIT 30", check_row_order=True, a=a)
+
+
+# ---------------------------------------------------------------------------
+# correlated EXISTS / NOT EXISTS / IN / NOT IN (reference: postgres
+# correlated-subquery coverage the sqlite suite skipped)
+# ---------------------------------------------------------------------------
+
+def test_correlated_exists_rand():
+    a = make_rand_df(30, k=(int, 5), va=float)
+    b = make_rand_df(25, k=(int, 5), vb=float)
+    eq_sqlite(
+        "SELECT k, va FROM a WHERE EXISTS "
+        "(SELECT 1 FROM b WHERE b.k = a.k)", a=a, b=b)
+
+
+def test_correlated_not_exists_rand():
+    a = make_rand_df(30, k=(int, 5), va=float)
+    b = make_rand_df(25, k=(int, 5), vb=float)
+    eq_sqlite(
+        "SELECT k, va FROM a WHERE NOT EXISTS "
+        "(SELECT 1 FROM b WHERE b.k = a.k)", a=a, b=b)
+
+
+def test_correlated_exists_with_condition_rand():
+    a = make_rand_df(40, k=(int, 6), va=float)
+    b = make_rand_df(30, k=(int, 6), vb=float)
+    eq_sqlite(
+        "SELECT k, va FROM a WHERE EXISTS "
+        "(SELECT 1 FROM b WHERE b.k = a.k AND b.vb < a.va)", a=a, b=b)
+
+
+def test_in_subquery_with_where_rand():
+    a = make_rand_df(40, k=(int, 6), va=float)
+    b = make_rand_df(30, k=(int, 6), vb=float)
+    eq_sqlite(
+        "SELECT k, va FROM a WHERE k IN (SELECT k FROM b WHERE vb > 3)",
+        a=a, b=b)
+
+
+def test_not_in_subquery_non_null_rand():
+    # NOT IN over a null-free build side (the null-poisoned case is covered
+    # by golden tests in test_semantics_oracle.py; sqlite agrees here)
+    a = make_rand_df(40, k=int, va=float)
+    b = make_rand_df(30, k=int, vb=float)
+    eq_sqlite(
+        "SELECT k, va FROM a WHERE k NOT IN (SELECT k FROM b WHERE vb > 2)",
+        a=a, b=b)
+
+
+@pytest.mark.xfail(
+    reason="correlated scalar subqueries decorrelate only as WHERE "
+           "comparison conjuncts today; SELECT-list position needs the "
+           "LEFT-JOIN-on-grouped-subquery rewrite (binder.py TODO)",
+    strict=True)
+def test_correlated_scalar_subquery_in_select_rand():
+    a = make_rand_df(30, k=(int, 4), va=float)
+    b = make_rand_df(40, k=(int, 4), vb=float)
+    eq_sqlite(
+        "SELECT k, va, (SELECT MAX(vb) FROM b WHERE b.k = a.k) AS mx "
+        "FROM a", a=a, b=b)
+
+
+def test_correlated_scalar_where_comparison_rand():
+    a = make_rand_df(30, k=(int, 4), va=float)
+    b = make_rand_df(40, k=(int, 4), vb=float)
+    eq_sqlite(
+        "SELECT k, va FROM a WHERE va > "
+        "(SELECT AVG(vb) FROM b WHERE b.k = a.k)", a=a, b=b)
+
+
+# ---------------------------------------------------------------------------
+# window frames: explicit ROWS / RANGE bounds (reference: postgres window
+# coverage; sqlite >= 3.28 implements the standard frame semantics)
+# ---------------------------------------------------------------------------
+
+def test_window_rows_unbounded_following_rand():
+    a = make_rand_df(80, a=float, b=(int, 30), c=(str, 30))
+    eq_sqlite(
+        """
+        SELECT a, b,
+            SUM(b) OVER (PARTITION BY c ORDER BY a NULLS FIRST
+                ROWS BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING) AS s1,
+            SUM(b) OVER (PARTITION BY c ORDER BY a NULLS FIRST
+                ROWS BETWEEN 1 FOLLOWING AND UNBOUNDED FOLLOWING) AS s2,
+            MIN(b) OVER (PARTITION BY c ORDER BY a NULLS FIRST
+                ROWS BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING) AS s3
+        FROM a ORDER BY a NULLS FIRST, b NULLS FIRST, c NULLS FIRST
+        """, check_row_order=True, a=a)
+
+
+def test_window_default_frame_peers_rand():
+    # ties under ORDER BY: the default frame is RANGE (peer-inclusive) —
+    # the class postgres catches and row-based engines get wrong
+    a = make_rand_df(100, a=(int, 20), b=(int, 30), c=(str, 20))
+    eq_sqlite(
+        """
+        SELECT a, b, c,
+            SUM(b) OVER (PARTITION BY c ORDER BY a NULLS FIRST) AS s1,
+            COUNT(*) OVER (ORDER BY a NULLS FIRST) AS s2,
+            AVG(b) OVER (PARTITION BY c ORDER BY a NULLS FIRST) AS s3
+        FROM a ORDER BY a NULLS FIRST, b NULLS FIRST, c NULLS FIRST
+        """, check_row_order=True, a=a)
+
+
+def test_window_range_current_row_rand():
+    a = make_rand_df(80, a=(int, 15), b=int, c=(str, 15))
+    eq_sqlite(
+        """
+        SELECT a, b,
+            SUM(b) OVER (PARTITION BY c ORDER BY a NULLS FIRST
+                RANGE BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS s1,
+            SUM(b) OVER (PARTITION BY c ORDER BY a NULLS FIRST
+                RANGE BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING) AS s2
+        FROM a ORDER BY a NULLS FIRST, b NULLS FIRST, c NULLS FIRST
+        """, check_row_order=True, a=a)
+
+
+def test_window_range_value_offsets_rand():
+    # RANGE <n> PRECEDING/FOLLOWING is VALUE-based (not row-based): needs a
+    # single numeric non-null ORDER BY key, exactly postgres' rule
+    a = make_rand_df(80, a=int, b=int, c=(str, 20))
+    eq_sqlite(
+        """
+        SELECT a, b,
+            SUM(b) OVER (PARTITION BY c ORDER BY a
+                RANGE BETWEEN 2 PRECEDING AND CURRENT ROW) AS s1,
+            COUNT(*) OVER (PARTITION BY c ORDER BY a
+                RANGE BETWEEN 1 PRECEDING AND 3 FOLLOWING) AS s2,
+            SUM(b) OVER (ORDER BY a
+                RANGE BETWEEN CURRENT ROW AND 2 FOLLOWING) AS s3
+        FROM a ORDER BY a, b NULLS FIRST, c NULLS FIRST
+        """, check_row_order=True, a=a)
+
+
+def test_window_range_desc_value_offsets_rand():
+    a = make_rand_df(60, a=int, b=int)
+    eq_sqlite(
+        """
+        SELECT a, b,
+            SUM(b) OVER (ORDER BY a DESC
+                RANGE BETWEEN 2 PRECEDING AND CURRENT ROW) AS s1
+        FROM a ORDER BY a, b
+        """, check_row_order=True, a=a)
+
+
+def test_window_first_last_value_frames_rand():
+    a = make_rand_df(60, a=float, b=(int, 20), c=(str, 15))
+    eq_sqlite(
+        """
+        SELECT a, b,
+            FIRST_VALUE(b) OVER (PARTITION BY c ORDER BY a NULLS FIRST
+                ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS f1,
+            LAST_VALUE(b) OVER (PARTITION BY c ORDER BY a NULLS FIRST
+                ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS f2,
+            LAST_VALUE(b) OVER (PARTITION BY c ORDER BY a NULLS FIRST) AS f3
+        FROM a ORDER BY a NULLS FIRST, b NULLS FIRST, c NULLS FIRST
+        """, check_row_order=True, a=a)
+
+
+def test_window_last_value_default_frame_peers():
+    # LAST_VALUE under the default frame returns the last PEER, not the
+    # current row (sqlite + postgres agree; row-based engines return self)
+    df = pd.DataFrame({"k": [1, 1, 2, 2, 3], "v": [10., 20., 30., 40., 50.]})
+    eq_sqlite("SELECT k, v, LAST_VALUE(v) OVER (ORDER BY k) AS lv FROM t "
+              "ORDER BY k, v", check_row_order=True, t=df)
+
+
+# ---------------------------------------------------------------------------
+# INTERVAL / date arithmetic — sqlite has no interval type, so these are
+# GOLDEN tests with pandas-computed PostgreSQL-semantics expectations
+# (reference: fixtures.py datetime_table postgres coverage)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def date_ctx():
+    rng = np.random.RandomState(42)
+    n = 60
+    base = pd.Timestamp("1995-01-01")
+    d = base + pd.to_timedelta(rng.randint(0, 1200, n), unit="D")
+    df = pd.DataFrame({"d": d, "v": np.round(rng.rand(n) * 100, 2),
+                       "i": rng.randint(0, 10, n)})
+    ctx = Context()
+    ctx.create_table("t", df)
+    return ctx, df
+
+
+def test_date_plus_interval_days(date_ctx):
+    ctx, df = date_ctx
+    got = ctx.sql("SELECT d + INTERVAL '90' DAY AS d2 FROM t",
+                  return_futures=False)
+    want = pd.DataFrame({"d2": df["d"] + pd.Timedelta(days=90)})
+    assert_eq(got, want)
+
+
+def test_date_minus_interval_filter(date_ctx):
+    ctx, df = date_ctx
+    got = ctx.sql(
+        "SELECT COUNT(*) AS n FROM t WHERE d < DATE '1997-07-01' - "
+        "INTERVAL '90' DAY", return_futures=False)
+    lim = pd.Timestamp("1997-07-01") - pd.Timedelta(days=90)
+    assert int(got["n"][0]) == int((df["d"] < lim).sum())
+
+
+def test_date_interval_month_year(date_ctx):
+    ctx, df = date_ctx
+    got = ctx.sql(
+        "SELECT COUNT(*) AS n FROM t WHERE d >= DATE '1995-06-15' + "
+        "INTERVAL '3' MONTH AND d < DATE '1995-06-15' + INTERVAL '1' YEAR",
+        return_futures=False)
+    lo = pd.Timestamp("1995-09-15")
+    hi = pd.Timestamp("1996-06-15")
+    assert int(got["n"][0]) == int(((df["d"] >= lo) & (df["d"] < hi)).sum())
+
+
+def test_extract_fields_grouping(date_ctx):
+    ctx, df = date_ctx
+    got = ctx.sql(
+        "SELECT EXTRACT(YEAR FROM d) AS y, COUNT(*) AS n, SUM(v) AS s "
+        "FROM t GROUP BY EXTRACT(YEAR FROM d) ORDER BY y",
+        return_futures=False)
+    want = (df.assign(y=df["d"].dt.year).groupby("y")
+            .agg(n=("v", "size"), s=("v", "sum")).reset_index())
+    assert_eq(got, want)
+
+
+def test_date_difference_comparison(date_ctx):
+    ctx, df = date_ctx
+    # rows within 180 days of the minimum date
+    got = ctx.sql(
+        "SELECT COUNT(*) AS n FROM t WHERE d < (SELECT MIN(d) FROM t) + "
+        "INTERVAL '180' DAY", return_futures=False)
+    lim = df["d"].min() + pd.Timedelta(days=180)
+    assert int(got["n"][0]) == int((df["d"] < lim).sum())
+
+
+# ---------------------------------------------------------------------------
+# DECIMAL cast chains — golden (sqlite's NUMERIC affinity cannot judge
+# scale/rounding; postgres semantics: CAST rounds half-up at the target
+# scale, arithmetic keeps exactness)
+# ---------------------------------------------------------------------------
+
+def test_decimal_cast_rounding():
+    ctx = Context()
+    ctx.create_table("t", pd.DataFrame(
+        {"x": [1.004, 2.676, -1.004, 3.14159, 0.125]}))
+    got = ctx.sql("SELECT CAST(x AS DECIMAL(10, 2)) AS d FROM t",
+                  return_futures=False)
+    # quantization at scale 2; exact halves round HALF-EVEN (0.125 -> 0.12)
+    # — the engine's documented contract (physical/rex/cast.py:80-85),
+    # matching the reference's pandas substrate where a true decimal
+    # engine's half-up would give 0.13
+    assert [round(v, 2) for v in got["d"]] == [1.0, 2.68, -1.0, 3.14, 0.12]
+
+
+def test_decimal_chain_sum():
+    rng = np.random.RandomState(9)
+    cents = rng.randint(-10_000, 10_000, 200)
+    df = pd.DataFrame({"x": cents / 100.0})
+    ctx = Context()
+    ctx.create_table("t", df)
+    got = ctx.sql(
+        "SELECT SUM(CAST(x AS DECIMAL(12, 2))) AS s, "
+        "AVG(CAST(x AS DECIMAL(12, 2))) AS a FROM t",
+        return_futures=False)
+    # exact: the scaled-int representation must not lose cents
+    assert abs(float(got["s"][0]) - cents.sum() / 100.0) < 1e-9
+    assert abs(float(got["a"][0]) - cents.sum() / 100.0 / 200) < 1e-9
+
+
+def test_decimal_cast_chain_widening():
+    ctx = Context()
+    ctx.create_table("t", pd.DataFrame({"x": [1.115, 2.345, -0.555]}))
+    got = ctx.sql(
+        "SELECT CAST(CAST(x AS DECIMAL(10, 2)) AS DECIMAL(12, 1)) AS d "
+        "FROM t", return_futures=False)
+    # chain: 1.115 -> 1.12 -> 1.1 ; 2.345 -> 2.35 -> 2.4 (postgres:
+    # each cast re-rounds at ITS scale) ; -0.555 -> -0.56 -> -0.6
+    assert [round(v, 1) for v in got["d"]] == [1.1, 2.4, -0.6]
+
+
+def test_decimal_multiply_precision():
+    ctx = Context()
+    ctx.create_table("t", pd.DataFrame({"p": [19.99, 5.25, 100.01],
+                                        "q": [3, 7, 2]}))
+    got = ctx.sql(
+        "SELECT SUM(CAST(p AS DECIMAL(10, 2)) * q) AS rev FROM t",
+        return_futures=False)
+    assert abs(float(got["rev"][0]) - (19.99 * 3 + 5.25 * 7 + 100.01 * 2)) \
+        < 1e-9
