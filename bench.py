@@ -508,6 +508,10 @@ def main():
                     BENCH_PROGRESS=progress,
                     BENCH_PLATFORM_CHOICE=platform,
                     BENCH_SF=str(sf))
+    # never eager-fallback in the engine child: over the tunneled TPU the
+    # eager path is thousands of ~100 ms round trips that wedge the whole
+    # run behind one broken program — fail fast, journal warm_fail, move on
+    env_base.setdefault("DSQL_EAGER_FALLBACK", "0")
     env_base.setdefault("DSQL_XLA_CACHE", os.path.join(cache_root, "xla"))
     env_base.setdefault("DSQL_CAPS_FILE",
                         os.path.join(cache_root, "caps.json"))
@@ -557,6 +561,7 @@ def main():
             # exits when none do
         except subprocess.TimeoutExpired:
             proc.kill()
+            proc.communicate()  # reap: no zombie + closed pipe FDs
             print(f"bench: engine child {attempt} exceeded its "
                   f"{budget_left:.0f}s budget; collecting partials",
                   file=sys.stderr)
@@ -589,6 +594,7 @@ def main():
             proc.communicate(timeout=salvage_left)
         except subprocess.TimeoutExpired:
             proc.kill()
+            proc.communicate()  # reap
             state["stage_meta"].append({"attempt": "cpu_salvage",
                                         "error": "timeout"})
         finally:

@@ -45,8 +45,14 @@ def _blank(n: int) -> np.ndarray:
     return np.full(n, "", dtype=object)
 
 
-def generate_tpch(sf: float = 0.01, seed: int = 0) -> dict:
-    """Returns {table_name: pandas.DataFrame} for the 8 TPC-H tables."""
+def generate_tpch(sf: float = 0.01, seed: int = 0,
+                  small_only: bool = False) -> dict:
+    """Returns {table_name: pandas.DataFrame} for the 8 TPC-H tables.
+
+    ``small_only=True`` skips orders+lineitem (the ~95% of the bytes):
+    piecewise large-scale generation (generate_orders_lineitem_piece)
+    needs the dimension tables without paying a full-SF fact build.
+    """
     rng = np.random.RandomState(seed)
     n_part = max(int(200_000 * sf), 50)
     n_supp = max(int(10_000 * sf), 10)
@@ -122,6 +128,11 @@ def generate_tpch(sf: float = 0.01, seed: int = 0) -> dict:
         "c_mktsegment": rng.choice(_SEGMENTS, n_cust),
         "c_comment": _blank(n_cust),
     })
+    if small_only:
+        return {
+            "region": region, "nation": nation, "supplier": supplier,
+            "part": part, "partsupp": partsupp, "customer": customer,
+        }
     o_dates = rng.randint(_D("1992-01-01"), _D("1998-08-02"), n_ord)
     # dbgen: customers with custkey % 3 == 0 never place orders — Q22's
     # NOT EXISTS(orders) anti-join needs a real population to select
@@ -523,3 +534,78 @@ QUERIES = {
         LIMIT 100
     """,
 }
+
+
+def generate_orders_lineitem_piece(sf: float, piece: int, n_pieces: int,
+                                   seed: int = 0):
+    """One horizontal slice of the orders+lineitem pair at scale ``sf``.
+
+    Generating SF>=10 in one shot holds a ~10 GB lineitem frame (plus the
+    encoder's copies) in RAM — the r3 SF-10 certification peaked at 27 GB
+    because of exactly that.  Slices keep the dbgen invariants that matter:
+    sparse orderkeys (k*4) partitioned across pieces, o_custkey %3 hole
+    (Q22), the partsupp supplier formula (Q9), and per-order 1-7 lineitems.
+    Each piece uses its own seeded stream, so pieces are independent of
+    n_pieces only in SHAPE, not values — a piecewise dataset is its own
+    dataset (consistent across queries, not equal to generate_tpch(sf))."""
+    n_part = max(int(200_000 * sf), 50)
+    n_supp = max(int(10_000 * sf), 10)
+    n_cust = max(int(150_000 * sf), 30)
+    n_ord = max(int(1_500_000 * sf), 150)
+    lo = (n_ord * piece) // n_pieces
+    hi = (n_ord * (piece + 1)) // n_pieces
+    n_o = hi - lo
+    rng = np.random.RandomState((seed * 7919 + piece * 104729 + 13) % (1 << 31))
+    _ps_step = max(n_supp // 4, 1)
+
+    def _psupp(partkey, i):
+        return (partkey - 1 + i * _ps_step) % n_supp + 1
+
+    o_dates = rng.randint(_D("1992-01-01"), _D("1998-08-02"), n_o)
+    o_custkey = rng.randint(1, n_cust + 1, n_o)
+    o_custkey = o_custkey + (o_custkey % 3 == 0)
+    o_custkey = np.where(o_custkey > n_cust, 1, o_custkey)
+    okeys = (np.arange(lo, hi) + 1) * 4
+    orders = pd.DataFrame({
+        "o_orderkey": okeys,
+        "o_custkey": o_custkey,
+        "o_orderstatus": rng.choice(["F", "O", "P"], n_o,
+                                    p=[0.49, 0.49, 0.02]),
+        "o_totalprice": np.round(rng.uniform(800.0, 600_000.0, n_o), 2),
+        "o_orderdate": pd.to_datetime(o_dates, unit="D"),
+        "o_orderpriority": rng.choice(_PRIORITIES, n_o),
+        "o_clerk": _tag("Clerk#", np.arange(lo, hi) % 1000, 9),
+        "o_shippriority": np.zeros(n_o, dtype=np.int64),
+        "o_comment": _blank(n_o),
+    })
+    lines_per_order = rng.randint(1, 8, n_o)
+    n_li = int(lines_per_order.sum())
+    li_order = np.repeat(okeys, lines_per_order)
+    li_odate = np.repeat(o_dates, lines_per_order)
+    ship = li_odate + rng.randint(1, 122, n_li)
+    commit = li_odate + rng.randint(30, 91, n_li)
+    receipt = ship + rng.randint(1, 31, n_li)
+    returnflag = np.where(receipt <= _D("1995-06-17"),
+                          rng.choice(["R", "A"], n_li), "N")
+    li_partkey = rng.randint(1, n_part + 1, n_li)
+    lineitem = pd.DataFrame({
+        "l_orderkey": li_order,
+        "l_partkey": li_partkey,
+        "l_suppkey": _psupp(li_partkey, rng.randint(0, 4, n_li)),
+        "l_linenumber": np.arange(n_li) - np.repeat(
+            np.cumsum(lines_per_order) - lines_per_order,
+            lines_per_order) + 1,
+        "l_quantity": rng.randint(1, 51, n_li).astype(np.float64),
+        "l_extendedprice": np.round(rng.uniform(900.0, 105_000.0, n_li), 2),
+        "l_discount": np.round(rng.randint(0, 11, n_li) / 100.0, 2),
+        "l_tax": np.round(rng.randint(0, 9, n_li) / 100.0, 2),
+        "l_returnflag": returnflag,
+        "l_linestatus": np.where(ship > _D("1995-06-17"), "O", "F"),
+        "l_shipdate": pd.to_datetime(ship, unit="D"),
+        "l_commitdate": pd.to_datetime(commit, unit="D"),
+        "l_receiptdate": pd.to_datetime(receipt, unit="D"),
+        "l_shipinstruct": rng.choice(_INSTRUCTS, n_li),
+        "l_shipmode": rng.choice(_SHIPMODES, n_li),
+        "l_comment": _blank(n_li),
+    })
+    return orders, lineitem

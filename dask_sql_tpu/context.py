@@ -101,7 +101,9 @@ class Context:
             # distributed at once, like the reference's partitioned model
             from .io.chunked import DEFAULT_BATCH_ROWS, ChunkedSource
             rows = batch_rows or DEFAULT_BATCH_ROWS
-            if isinstance(input_table, str):
+            if isinstance(input_table, ChunkedSource):
+                source = input_table  # pre-built (e.g. from_parquet caller)
+            elif isinstance(input_table, str):
                 source = ChunkedSource.from_parquet(input_table,
                                                     batch_rows=rows)
             else:

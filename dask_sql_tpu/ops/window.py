@@ -187,12 +187,20 @@ def compute_window(table: Table, op: str, arg_cols: List[int],
     # peer-group (tie) bounds under the ORDER BY keys: SQL's default frame
     # and RANGE CURRENT ROW are PEER-inclusive (PostgreSQL/SQLite agree;
     # treating them as row bounds was the r4 oracle-caught bug)
+    _frame_consumers = ("COUNT", "SUM", "$SUM0", "AVG", "MIN", "MAX",
+                        "FIRST_VALUE", "LAST_VALUE", "NTH_VALUE",
+                        "SINGLE_VALUE", "CUME_DIST")
     if order_keys:
         tie_start = segmented_scan(jnp.where(tie | starts, pos, -1), starts,
                                    jnp.maximum)
-        is_last_of_tie = jnp.concatenate([tie[1:] | starts[1:],
-                                          jnp.ones(1, bool)])
-        tie_end = _backward_fill_positions(pos, is_last_of_tie, seg_end)
+        if op in _frame_consumers:
+            # two extra passes — only ops that read frame bounds (or
+            # CUME_DIST) pay them; rank/navigation ops skip
+            is_last_of_tie = jnp.concatenate([tie[1:] | starts[1:],
+                                              jnp.ones(1, bool)])
+            tie_end = _backward_fill_positions(pos, is_last_of_tie, seg_end)
+        else:
+            tie_end = seg_end
     else:
         tie_start, tie_end = seg_start, seg_end
 

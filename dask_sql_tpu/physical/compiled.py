@@ -2048,6 +2048,11 @@ def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
                 else:
                     # first strike may be transient — not exiled (yet)
                     stats["compile_errors"] += 1
+                if os.environ.get("DSQL_EAGER_FALLBACK", "1") == "0":
+                    # benchmark mode: over a tunneled TPU the eager path is
+                    # thousands of ~100 ms round trips — failing fast beats
+                    # wedging the whole run behind one broken program
+                    raise
                 return None
             stats["compiles"] += 1
             _cache[key] = entry

@@ -206,6 +206,9 @@ class Binder:
         # enclosing query's scope for correlated subqueries: unresolved
         # columns become RexOuterRef and are eliminated by decorrelation
         self.outer_scope = outer_scope
+        # SELECT-list correlated scalar subqueries decorrelated ahead of
+        # expression binding: AST node id -> replacement rex
+        self._select_sq_rex: Dict[int, RexNode] = {}
 
     def error(self, msg: str, node: Optional[A.Node] = None):
         pos = getattr(node, "pos", (0, 0)) if node is not None else (0, 0)
@@ -495,6 +498,45 @@ class Binder:
             return True, LogicalFilter(input=plan, condition=cmp,
                                        schema=list(plan.schema))
 
+        sub2, pairs, needed, count_like = self._decorrelate_scalar_agg(
+            sub_plan, sq)
+        nk = len(needed)
+
+        nl = len(plan.schema)
+        inner_of = {ii: pos for pos, ii in enumerate(needed)}
+        cond: Optional[RexNode] = None
+        for oi, ii, styp in pairs:
+            eq = RexCall("=", [
+                RexInputRef(oi, scope.entries[oi].stype),
+                RexInputRef(nl + inner_of[ii], styp)], BOOLEAN)
+            cond = eq if cond is None else RexCall("AND", [cond, eq], BOOLEAN)
+        joined = LogicalJoin(left=plan, right=sub2,
+                             join_type="LEFT" if count_like else "INNER",
+                             condition=cond,
+                             schema=list(plan.schema) + list(sub2.schema))
+        lhs = self.bind_expr(other_ast, scope)  # left columns keep positions
+        val: RexNode = RexInputRef(nl + nk, sub2.schema[-1].stype)
+        if count_like:
+            val = RexCall("COALESCE", [val, RexLiteral(0, val.stype)],
+                          val.stype)
+        cmp = RexCall(op, [lhs, val], BOOLEAN)
+        filt = LogicalFilter(input=joined, condition=cmp,
+                             schema=list(joined.schema))
+        out = LogicalProject(
+            input=filt,
+            exprs=[RexInputRef(i, f.stype) for i, f in enumerate(plan.schema)],
+            schema=list(plan.schema))
+        return True, out
+
+    def _decorrelate_scalar_agg(self, sub_plan: RelNode, sq: A.Subquery):
+        """Shared core of the correlated scalar-aggregate rewrite: turn a
+        whole-table-aggregate subquery correlated by equality predicates
+        into a grouped aggregate keyed by the correlation columns.
+        Returns ``(sub2, pairs, needed, count_like)``: the grouped subplan
+        (schema = correlation keys + original outputs), the (outer idx,
+        inner idx, type) equality pairs, the distinct inner key ordinals,
+        and whether the aggregate is COUNT-shaped (0, not NULL, over an
+        empty group — callers must LEFT-join + COALESCE)."""
         # peel output projections above the aggregate (e.g. 0.2 * AVG(x))
         projects: List[LogicalProject] = []
         core = sub_plan
@@ -578,32 +620,46 @@ class Binder:
             for P in projects)
         if count_like and (not trivial_projects or len(core.aggs) != 1):
             self.error("Unsupported correlated COUNT subquery shape", sq)
+        return sub2, pairs, needed, count_like
 
-        nl = len(plan.schema)
-        inner_of = {ii: pos for pos, ii in enumerate(needed)}
-        cond: Optional[RexNode] = None
-        for oi, ii, styp in pairs:
-            eq = RexCall("=", [
-                RexInputRef(oi, scope.entries[oi].stype),
-                RexInputRef(nl + inner_of[ii], styp)], BOOLEAN)
-            cond = eq if cond is None else RexCall("AND", [cond, eq], BOOLEAN)
-        joined = LogicalJoin(left=plan, right=sub2,
-                             join_type="LEFT" if count_like else "INNER",
-                             condition=cond,
-                             schema=list(plan.schema) + list(sub2.schema))
-        lhs = self.bind_expr(other_ast, scope)  # left columns keep positions
-        val: RexNode = RexInputRef(nl + nk, sub2.schema[-1].stype)
-        if count_like:
-            val = RexCall("COALESCE", [val, RexLiteral(0, val.stype)],
-                          val.stype)
-        cmp = RexCall(op, [lhs, val], BOOLEAN)
-        filt = LogicalFilter(input=joined, condition=cmp,
-                             schema=list(joined.schema))
-        out = LogicalProject(
-            input=filt,
-            exprs=[RexInputRef(i, f.stype) for i, f in enumerate(plan.schema)],
-            schema=list(plan.schema))
-        return True, out
+    def _decorrelate_select_subqueries(self, plan: RelNode, scope: Scope,
+                                       proj_items) -> RelNode:
+        """Correlated scalar-aggregate subqueries in the SELECT list:
+        LEFT-join the grouped subplan on the correlation keys and remember
+        the value column for bind_expr (postgres-class parity; the
+        reference gets this from Calcite's SubQueryRemoveRule).  A missing
+        group yields NULL (or 0 for COUNT via COALESCE) — exactly the
+        scalar subquery's empty-result semantics."""
+        for e, _alias in proj_items:
+            for sq in _walk_scalar_subqueries(e):
+                sub = Binder(self.catalog, self.sql, outer_scope=scope)
+                sub.cte_stack = self.cte_stack[:]
+                sub_plan = sub.bind_query(sq.query)
+                if not _plan_has_outer(sub_plan):
+                    continue  # uncorrelated: the ordinary rex path handles it
+                if len(sub_plan.schema) != 1:
+                    self.error("Scalar subquery must return one column", sq)
+                sub2, pairs, needed, count_like = \
+                    self._decorrelate_scalar_agg(sub_plan, sq)
+                nl = len(plan.schema)
+                inner_of = {ii: pos for pos, ii in enumerate(needed)}
+                cond: Optional[RexNode] = None
+                for oi, ii, styp in pairs:
+                    eq = RexCall("=", [
+                        RexInputRef(oi, scope.entries[oi].stype),
+                        RexInputRef(nl + inner_of[ii], styp)], BOOLEAN)
+                    cond = (eq if cond is None
+                            else RexCall("AND", [cond, eq], BOOLEAN))
+                plan = LogicalJoin(
+                    left=plan, right=sub2, join_type="LEFT", condition=cond,
+                    schema=list(plan.schema) + list(sub2.schema))
+                t = sub2.schema[-1].stype.with_nullable(True)
+                val: RexNode = RexInputRef(nl + len(needed), t)
+                if count_like:
+                    val = RexCall("COALESCE",
+                                  [val, RexLiteral(0, val.stype)], val.stype)
+                self._select_sq_rex[id(sq)] = val
+        return plan
 
     def _try_bind_subquery_conjunct(self, plan: RelNode, scope: Scope,
                                     c: A.Expr) -> Tuple[bool, RelNode]:
@@ -728,6 +784,10 @@ class Binder:
     # ----------------------------------------------------------- plain select
     def _bind_plain_query(self, plan: RelNode, scope: Scope, q: A.Select,
                           proj_items) -> Tuple[RelNode, List[Field], int]:
+        # correlated scalar subqueries in the SELECT list join their
+        # grouped subplans onto `plan` first (scope positions are left-side
+        # and stay valid; the final project drops the joined columns)
+        plan = self._decorrelate_select_subqueries(plan, scope, proj_items)
         bound = []
         names = []
         for e, alias in proj_items:
@@ -1037,6 +1097,10 @@ class Binder:
             return RexCall(op, [l, r], SqlType("BOOLEAN", nullable=False))
         if isinstance(e, A.Subquery):
             if e.kind == "scalar":
+                pre = self._select_sq_rex.get(id(e))
+                if pre is not None:
+                    # decorrelated ahead of binding (SELECT-list position)
+                    return pre
                 # bind with the outer scope visible so a correlated subquery
                 # in an unsupported position fails with a clear message, not
                 # a phantom "column not found"
@@ -1423,6 +1487,28 @@ def _plan_has_outer(plan: RelNode) -> bool:
     if any(_rex_has_outer(r) for r in _node_rexes(plan)):
         return True
     return any(_plan_has_outer(i) for i in plan.inputs)
+
+
+def _walk_scalar_subqueries(e):
+    """Yield scalar A.Subquery nodes inside an expression AST, without
+    descending into subquery bodies (each body is bound by its own
+    Binder; nested correlation resolves there)."""
+    import dataclasses
+
+    if isinstance(e, A.Subquery):
+        if e.kind == "scalar":
+            yield e
+        return
+    if not dataclasses.is_dataclass(e):
+        return
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name, None)
+        if isinstance(v, A.Node):
+            yield from _walk_scalar_subqueries(v)
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                if isinstance(item, A.Node):
+                    yield from _walk_scalar_subqueries(item)
 
 
 def _extract_correlated(plan: RelNode, binder: "Binder", node: A.Node):

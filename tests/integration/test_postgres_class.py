@@ -95,17 +95,23 @@ def test_not_in_subquery_non_null_rand():
         a=a, b=b)
 
 
-@pytest.mark.xfail(
-    reason="correlated scalar subqueries decorrelate only as WHERE "
-           "comparison conjuncts today; SELECT-list position needs the "
-           "LEFT-JOIN-on-grouped-subquery rewrite (binder.py TODO)",
-    strict=True)
 def test_correlated_scalar_subquery_in_select_rand():
+    # SELECT-list position: decorrelated to a LEFT join on the grouped
+    # subplan (binder._decorrelate_select_subqueries, landed r4)
     a = make_rand_df(30, k=(int, 4), va=float)
     b = make_rand_df(40, k=(int, 4), vb=float)
     eq_sqlite(
         "SELECT k, va, (SELECT MAX(vb) FROM b WHERE b.k = a.k) AS mx "
         "FROM a", a=a, b=b)
+
+
+def test_correlated_count_subquery_in_select():
+    # COUNT over an empty correlated group is 0, not NULL (LEFT + COALESCE)
+    a = pd.DataFrame({"k": [1, 2, 3, 4]})
+    b = pd.DataFrame({"k": [1, 1, 3]})
+    eq_sqlite(
+        "SELECT k, (SELECT COUNT(*) FROM b WHERE b.k = a.k) AS n "
+        "FROM a ORDER BY k", check_row_order=True, a=a, b=b)
 
 
 def test_correlated_scalar_where_comparison_rand():
