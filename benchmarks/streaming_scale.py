@@ -120,7 +120,8 @@ def _gen_to_parquet():
               flush=True)
     for w in writers.values():
         w.close()
-    open(marker, "w").close()
+    with open(marker, "w") as f:
+        f.write(stamp)
 
 
 def _oracle_main(qid: int, out_path: str):

@@ -26,6 +26,7 @@ scheduler" design of SURVEY §5.
 from __future__ import annotations
 
 import hashlib
+import threading as _threading
 import logging
 import math
 import os
@@ -235,13 +236,21 @@ def _mix64(z: jax.Array) -> jax.Array:
 
 
 class _VT:
-    """A padded device table + row-validity mask (None = all rows valid)."""
+    """A padded device table + row-validity mask (None = all rows valid).
 
-    __slots__ = ("table", "valid")
+    ``weight`` is the PRE-compaction row count (defaults to the physical
+    row count): heuristics that pick sides by size — the INNER-join
+    probe/build choice — must see the logical stream size, or a compacted
+    fact side masquerades as small, becomes the build, and its duplicate
+    keys trip the unique-build fallback."""
 
-    def __init__(self, table: Table, valid: Optional[jax.Array]):
+    __slots__ = ("table", "valid", "weight")
+
+    def __init__(self, table: Table, valid: Optional[jax.Array],
+                 weight: Optional[int] = None):
         self.table = table
         self.valid = valid
+        self.weight = weight if weight is not None else table.num_rows
 
     @property
     def n(self) -> int:
@@ -798,8 +807,12 @@ class _Tracer:
         self.fallback: List[jax.Array] = []      # device bools -> eager rerun
         self.ngroups: List[jax.Array] = []        # device ints, order = walk
         self.ngroup_caps: List[int] = []          # matching static caps
-        self.agg_sites: List[Tuple[int, bool]] = []  # (input rows, hashed?)
+        self.agg_sites: List[Tuple[int, bool, str]] = []  # (rows, hashed, tag)
         self._agg_counter = 0
+        self._cmp_counter = 0
+        # filter nodes (by id) eligible for learned-capacity compaction —
+        # computed by _compact_eligible over the whole plan before tracing
+        self.compact_ok: set = set()
 
     def traced_scalar_subquery(self, rex, outer_table: Table) -> Column:
         """Inline an uncorrelated scalar subquery into this trace.
@@ -848,7 +861,8 @@ class _Tracer:
             if isinstance(v, Scalar):
                 v = Column.from_scalar(v, src.n)
             cols.append(v)
-        return _VT(Table([f.name for f in rel.schema], cols), src.valid)
+        return _VT(Table([f.name for f in rel.schema], cols), src.valid,
+                   weight=src.weight)
 
     def _LogicalFilter(self, rel: LogicalFilter) -> _VT:
         src = self.run(rel.input)
@@ -858,7 +872,41 @@ class _Tracer:
                 return src
             return _VT(src.table, jnp.zeros(src.n, dtype=bool))
         valid = mask if src.valid is None else (mask & src.valid)
-        return _VT(src.table, valid)
+        out = _VT(src.table, valid, weight=src.weight)
+        if id(rel) in self.compact_ok:
+            out = self._maybe_compact(out)
+        return out
+
+    def _maybe_compact(self, vt: _VT) -> _VT:
+        """Learned-capacity COMPACTION after a selective filter: static
+        shapes mean a filter that drops 98% of lineitem still feeds all n
+        masked rows into every join/sort above it — the single biggest
+        steady-state tax vs the reference's dynamic partitions.  Compact to
+        a power-of-2 capacity learned through the same flags/recompile
+        machinery as group caps: cumsum + small gathers (~tens of ms)
+        where every downstream sort then costs cap instead of n.  A
+        learned cap >= n/2 disables the site (unselective filter)."""
+        n = vt.n
+        if n < (1 << 16):
+            return vt  # small inputs: gathers save nothing
+        tag = f"cmp{self._cmp_counter}"
+        self._cmp_counter += 1
+        default_cap = 1 << max(int((max(n // 4, 1) - 1)).bit_length(), 10)
+        cap = min(self.caps.get(tag, default_cap), n)
+        if cap * 2 >= n:
+            return vt  # learned: not selective enough to pay the gathers
+        mask = vt.vmask()
+        count = mask.sum()
+        idx = jnp.nonzero(mask, size=cap, fill_value=0)[0]
+        row_valid = jnp.arange(cap) < count
+        cols = [c.take(idx) for c in vt.table.columns]
+        # count > cap rows were silently dropped: the flags check raises
+        # _NeedsRecompile before any result materializes
+        self.ngroups.append(count)
+        self.ngroup_caps.append(cap)
+        self.agg_sites.append((n, False, tag))
+        return _VT(Table(list(vt.table.names), cols), row_valid,
+                   weight=vt.weight)
 
     def _LogicalValues(self, rel: LogicalValues) -> _VT:
         from .rel.executor import _values
@@ -899,7 +947,7 @@ class _Tracer:
             # CPU/GPU: hash-table codes + scatter segment aggregates — the
             # group sort this path replaces costs ~350 ms at 600k rows on
             # XLA:CPU while segment_sum costs ~2 ms
-            return self._hashed_aggregate(rel, src, key_cols, cap)
+            return self._hashed_aggregate(rel, src, key_cols, cap, tag)
 
         # every column an aggregate reads rides the group sort as payload —
         # cheaper than a post-sort take(perm) random gather per column
@@ -936,7 +984,7 @@ class _Tracer:
         self.fallback.append(gs.collision)
         self.ngroups.append(gs.num_groups)
         self.ngroup_caps.append(cap)
-        self.agg_sites.append((n, False))
+        self.agg_sites.append((n, False, tag))
 
         for ki in rel.group_keys:
             out_cols.append(src.table.columns[ki].take(gs.first_rows))
@@ -968,7 +1016,7 @@ class _Tracer:
         return _VT(Table(out_names, out_cols), row_valid)
 
     def _hashed_aggregate(self, rel, src: _VT, key_cols: List[Column],
-                          cap: int) -> _VT:
+                          cap: int, tag: str) -> _VT:
         """General GROUP BY off-TPU: hash-table group codes in original row
         order (no sort), then each aggregate is a segment_* scatter keyed on
         the dense codes — the same kernels the eager path uses
@@ -983,7 +1031,7 @@ class _Tracer:
         self.fallback.append(coll)
         self.ngroups.append(num_groups)
         self.ngroup_caps.append(cap)
-        self.agg_sites.append((n, True))
+        self.agg_sites.append((n, True, tag))
 
         out_cols: List[Column] = []
         for ki in rel.group_keys:
@@ -1238,8 +1286,8 @@ class _Tracer:
             probe, build, probe_is_left = right, left, False
             pk_cols = [right.table.columns[i] for i in rk]
             bk_cols = [left.table.columns[i] for i in lk]
-        else:  # INNER: probe the bigger side
-            if left.n >= right.n:
+        else:  # INNER: probe the bigger side (by pre-compaction weight)
+            if left.weight >= right.weight:
                 probe, build, probe_is_left = left, right, True
                 pk_cols = [left.table.columns[i] for i in lk]
                 bk_cols = [right.table.columns[i] for i in rk]
@@ -1291,7 +1339,7 @@ class _Tracer:
 
         if jt == "SEMI":
             return _VT(probe.table.with_names(out_names),
-                       probe.vmask() & match)
+                       probe.vmask() & match, weight=probe.weight)
         if jt == "ANTI":
             keep = ~match
             if getattr(rel, "null_aware", False):
@@ -1305,7 +1353,7 @@ class _Tracer:
                 keep = (keep & ~build_has_null
                         & (pvalid | ~build_nonempty))
             return _VT(probe.table.with_names(out_names),
-                       probe.vmask() & keep)
+                       probe.vmask() & keep, weight=probe.weight)
 
         def _pairs(build_cols: List[Column]) -> Table:
             if probe_is_left:
@@ -1325,11 +1373,12 @@ class _Tracer:
             match = match & pred
 
         if jt == "INNER":
-            return _VT(_pairs(gathered), probe.vmask() & match)
+            return _VT(_pairs(gathered), probe.vmask() & match,
+                       weight=probe.weight)
         # LEFT/RIGHT: every (valid) probe row survives; the build side is
         # NULL wherever the full ON condition (equi + residual) failed
         gathered = [c.with_mask(c.valid_mask() & match) for c in gathered]
-        return _VT(_pairs(gathered), probe.valid)
+        return _VT(_pairs(gathered), probe.valid, weight=probe.weight)
 
     def _append_join_flags(self, jt, adj: jax.Array, raw_diffs) -> None:
         """Shared fallback policy for both join strategies. ``adj`` marks
@@ -1849,7 +1898,12 @@ def _build(plan: RelNode, context, scans, caps: Dict[str, int], key):
             if has_valid:
                 valid = flat[i]; i += 1
             tables[skey] = (Table(names, cols), valid)
+        from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
         tr = _Tracer(context, tables, caps)
+        if _on_tpu() and os.environ.get("DSQL_COMPACT", "1") != "0":
+            # TPU only: off-TPU the hash kernels already cost O(valid rows)
+            # and gathers/scatters are ~1 ms — compaction buys nothing there
+            tr.compact_ok = _compact_eligible(plan)
         out = tr.run(plan)
         n = out.n
         if out.valid is None:
@@ -1888,15 +1942,37 @@ class _NeedsRecompile(Exception):
 SMALL_FETCH_BYTES = 8 << 20
 
 
+def _compact_eligible(plan: RelNode) -> set:
+    """ids of LogicalFilter nodes worth compacting: the TOPMOST filter of
+    each filter chain that has at least one join/aggregate/window ancestor
+    (the compaction pays for itself through the heavy op's sorts)."""
+    out: set = set()
+
+    def walk(rel: RelNode, heavy_above: bool, parent_is_filter: bool):
+        is_filter = isinstance(rel, LogicalFilter)
+        if is_filter and heavy_above and not parent_is_filter:
+            out.add(id(rel))
+        heavy = heavy_above or isinstance(
+            rel, (LogicalJoin, LogicalAggregate, LogicalWindow))
+        for i in rel.inputs:
+            walk(i, heavy, is_filter)
+
+    walk(plan, False, False)
+    return out
+
+
 def _check_flags(entry: _Compiled, flags) -> None:
-    """Raise _NeedsRecompile on group-cap overflow; flags[0] => eager."""
+    """Raise _NeedsRecompile on group-cap overflow; flags[0] => eager.
+    Compaction sites (tag cmp*) additionally SHRINK: a cap far above the
+    observed count recompiles once to a tight one (persisted, so future
+    processes trace tight directly)."""
     meta = entry.meta
     ngroups = flags[2:]
     new_caps = dict(entry.caps)
     grew = False
     for i, (ng, cap) in enumerate(zip(ngroups, meta["ngroup_caps"])):
+        n_rows, hashed, tag = meta["agg_sites"][i]
         if ng > cap:
-            n_rows, hashed = meta["agg_sites"][i]
             if hashed and int(ng) > n_rows:
                 # ng = n+1 is the hashed path's SATURATED sentinel: the true
                 # group count is unknowable from this run.  Jump hard (x16,
@@ -1907,8 +1983,15 @@ def _check_flags(entry: _Compiled, flags) -> None:
                 need = min(1 << (int(n_rows) - 1).bit_length(), cap * 16)
             else:
                 need = 1 << (int(ng) - 1).bit_length()
-            new_caps[f"agg{i}"] = max(need, cap * 2)
+            new_caps[tag] = max(need, cap * 2)
             grew = True
+        elif tag.startswith("cmp"):
+            tight = 1 << max(int(max(int(ng), 1) - 1).bit_length(), 10)
+            if tight * 8 <= cap:
+                # one recompile to the tight cap: every downstream sort in
+                # the steady-state program shrinks by >= 8x
+                new_caps[tag] = max(tight * 2, 1024)
+                grew = True
     if grew:
         raise _NeedsRecompile(new_caps)
 
@@ -1972,10 +2055,132 @@ def _materialize(entry: _Compiled, outs) -> Table:
     return t
 
 
+# ---------------------------------------------------------------------------
+# whole-plan splitting: XLA:TPU compile time grows superlinearly with the
+# number of fused join/aggregate pipelines in one program — TPC-H Q2 (9
+# heavy nodes after decorrelation) never finished compiling over the
+# tunneled TPU (>27 min observed), while 2-join programs compile in tens of
+# seconds.  Above DSQL_SPLIT_HEAVY heavy nodes the plan executes as TWO
+# compiled programs with the subtree result materialized to a resident temp
+# between them (one extra ~100 ms device round trip; both halves hit the
+# program cache independently).
+# ---------------------------------------------------------------------------
+
+_SPLIT_SCHEMA = "__split__"
+
+
+def _heavy_count(rel: RelNode) -> int:
+    n = 1 if isinstance(rel, (LogicalJoin, LogicalAggregate,
+                              LogicalWindow)) else 0
+    return n + sum(_heavy_count(i) for i in rel.inputs)
+
+
+def _split_point(plan: RelNode) -> Optional[RelNode]:
+    """The subtree to peel into its own program: the node whose heavy-node
+    count is closest to half the total (never the root, never a leaf)."""
+    total = _heavy_count(plan)
+    # observed on the tunneled TPU: ~50 s compile at 2 heavy nodes, ~400 s
+    # at 6 (tractable, and cached thereafter), never-finishes at 8-9 — so
+    # only the truly uncompilable plans split.  A lower threshold also
+    # risks cutting at an edge that feeds a join as a duplicate-key build
+    # (runtime fallback): TPC-H Q9 at threshold 5 does exactly that.
+    limit = int(os.environ.get("DSQL_SPLIT_HEAVY", "6"))
+    if total <= limit:
+        return None
+    best, best_d = None, None
+
+    def walk(rel: RelNode, is_root: bool):
+        nonlocal best, best_d
+        if not is_root:
+            h = _heavy_count(rel)
+            if 2 <= h <= total - 1:
+                d = abs(h - total / 2)
+                if best_d is None or d < best_d:
+                    best, best_d = rel, d
+        for i in rel.inputs:
+            walk(i, False)
+
+    walk(plan, True)
+    return best
+
+
+def _replace_node(plan: RelNode, old: RelNode, new: RelNode) -> RelNode:
+    if plan is old:
+        return new
+    if not plan.inputs:
+        return plan
+    return plan.with_inputs([_replace_node(i, old, new)
+                             for i in plan.inputs])
+
+
+_split_lock = _threading.Lock()
+_split_refs: Dict[tuple, int] = {}
+
+
+def _execute_split(plan: RelNode, node: RelNode, context) -> Optional[Table]:
+    from ..datacontainer import TableEntry
+    from ..plan.nodes import Field, LogicalTableScan
+
+    sub = try_execute_compiled(node, context)  # may split again, recursively
+    if sub is None:
+        return None  # subtree not compilable: let the caller's policy run
+    # DETERMINISTIC temp name from the subtree's shape: the name feeds the
+    # OUTER program's plan fingerprint, so a per-execution counter would
+    # recompile the outer half on every run (and leak dead cache entries).
+    # Identical digests mean identical subplans over the same catalog —
+    # concurrent overwrite is then harmless (equal content).
+    digest = hashlib.blake2s(
+        (node.explain() + "|"
+         + ",".join(f.stype.name for f in node.schema)).encode()
+    ).hexdigest()[:16]
+    name = f"t{digest}"
+    # pad to a power-of-2 capacity with row validity: the outer program is
+    # keyed on input SHAPES, and the subtree's true row count is data-
+    # dependent — capacity classes keep the key stable across runs
+    n = sub.num_rows
+    cap = 1 << max((max(n, 1) - 1).bit_length(), 6)
+    sub = sub.with_names([f"c{i}" for i in range(sub.num_columns)])
+    if cap != n:
+        pad = cap - n
+        pcols = []
+        for c in sub.columns:
+            data = jnp.concatenate(
+                [c.data, jnp.zeros((pad,) + c.data.shape[1:],
+                                   dtype=c.data.dtype)])
+            mask = (None if c.mask is None else
+                    jnp.concatenate([c.mask, jnp.zeros(pad, dtype=bool)]))
+            pcols.append(Column(data, c.stype, mask, c.dictionary))
+        sub = Table(list(sub.names), pcols)
+    row_valid = jnp.arange(cap) < n
+    ref_key = (id(context), name)
+    with _split_lock:
+        if _SPLIT_SCHEMA not in context.schema:
+            context.create_schema(_SPLIT_SCHEMA)
+        context.schema[_SPLIT_SCHEMA].tables[name] = TableEntry(
+            table=sub, row_valid=row_valid)
+        _split_refs[ref_key] = _split_refs.get(ref_key, 0) + 1
+    scan = LogicalTableScan(
+        schema_name=_SPLIT_SCHEMA, table_name=name,
+        schema=[Field(f"c{i}", f.stype)
+                for i, f in enumerate(node.schema)])
+    try:
+        return try_execute_compiled(_replace_node(plan, node, scan),
+                                    context)
+    finally:
+        with _split_lock:
+            _split_refs[ref_key] -= 1
+            if _split_refs[ref_key] <= 0:
+                _split_refs.pop(ref_key, None)
+                context.schema[_SPLIT_SCHEMA].tables.pop(name, None)
+
+
 def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
     """Execute via the compiled pipeline; None => caller should run eager."""
     if os.environ.get("DSQL_COMPILE", "1") == "0":
         return None
+    split_at = _split_point(plan)
+    if split_at is not None:
+        return _execute_split(plan, split_at, context)
     from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
     host_sort = None
     if not _on_tpu() and isinstance(plan, LogicalSort):

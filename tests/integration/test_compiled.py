@@ -423,3 +423,76 @@ def test_compiled_path_uses_device_string_bitmap(monkeypatch):
     assert out["n"].tolist() == [100]
     assert compiled.stats["compiles"] > before["compiles"]  # compiled ran
     assert strings_fast.stats["device_bitmaps"] > before_dev  # device path
+
+
+def test_plan_splitting_matches_whole(monkeypatch):
+    """Plans above DSQL_SPLIT_HEAVY heavy nodes execute as two compiled
+    programs with a materialized temp between them (XLA:TPU compile time
+    grows superlinearly with fused join count; TPC-H Q2's 9-heavy program
+    never finished compiling over the tunnel).  Forced low threshold: the
+    split path must agree with the unsplit/eager answer and leave no temp
+    schema behind."""
+    import pandas as pd
+
+    from benchmarks.tpch import QUERIES, generate_tpch
+    from dask_sql_tpu import Context
+
+    monkeypatch.setenv("DSQL_SPLIT_HEAVY", "3")
+    monkeypatch.delenv("DSQL_STRATEGY", raising=False)
+    data = generate_tpch(0.005)
+    c1 = Context()
+    for n, f in data.items():
+        c1.create_table(n, f)
+    for q in (2, 21, 18):
+        got = c1.sql(QUERIES[q], return_futures=False)
+        monkeypatch.setenv("DSQL_COMPILE", "0")
+        want = c1.sql(QUERIES[q], return_futures=False)
+        monkeypatch.setenv("DSQL_COMPILE", "1")
+        pd.testing.assert_frame_equal(
+            got.reset_index(drop=True), want.reset_index(drop=True),
+            check_dtype=False, rtol=1e-5, atol=1e-8)
+        split_schema = c1.schema.get("__split__")
+        assert not (split_schema and split_schema.tables), \
+            "split temps must be cleaned up"
+
+
+def test_filter_compaction_learned_caps(monkeypatch):
+    """Learned-capacity compaction after selective filters (TPU strategy):
+    the compiled result must match eager, engage only above the size
+    threshold, learn a tight cap via one shrink recompile, and not flip
+    join build sides onto duplicate-key fact streams (the weight
+    mechanism)."""
+    import numpy as np
+
+    from dask_sql_tpu.physical import compiled as cm
+
+    monkeypatch.setenv("DSQL_STRATEGY", "tpu")
+    monkeypatch.delenv("DSQL_CAPS_FILE", raising=False)
+    rng = np.random.RandomState(0)
+    n = 1 << 17  # above the compaction threshold
+    fact = pd.DataFrame({
+        "k": rng.randint(0, 5000, n),
+        "sel": rng.randint(0, 100, n),
+        "v": rng.randn(n),
+    })
+    dim = pd.DataFrame({"k": np.arange(5000),
+                        "name": [f"d{i}" for i in range(5000)]})
+    from dask_sql_tpu import Context
+    ctx = Context()
+    ctx.create_table("fact", fact)
+    ctx.create_table("dim", dim)
+    q = ("SELECT name, SUM(v) AS s, COUNT(*) AS c FROM fact "
+         "JOIN dim ON fact.k = dim.k WHERE sel < 3 GROUP BY name")
+    rec = cm.stats["recompiles"]
+    fb = cm.stats["fallbacks"]
+    got = ctx.sql(q, return_futures=False)
+    monkeypatch.setenv("DSQL_COMPILE", "0")
+    want = ctx.sql(q, return_futures=False)
+    monkeypatch.setenv("DSQL_COMPILE", "1")
+    cols = list(got.columns)
+    pd.testing.assert_frame_equal(
+        got.sort_values(cols, ignore_index=True),
+        want.sort_values(cols, ignore_index=True),
+        check_dtype=False, rtol=1e-6, atol=1e-9)
+    assert cm.stats["fallbacks"] == fb, "compaction must not cause fallback"
+    assert cm.stats["recompiles"] > rec, "shrink recompile expected"
