@@ -2070,8 +2070,15 @@ _SPLIT_SCHEMA = "__split__"
 
 
 def _heavy_count(rel: RelNode) -> int:
-    n = 1 if isinstance(rel, (LogicalJoin, LogicalAggregate,
-                              LogicalWindow)) else 0
+    if isinstance(rel, LogicalJoin):
+        # SEMI/ANTI lower through the payload exist-test formulation whose
+        # compile cost dwarfs a plain equi-join — TPC-H Q21 (two of them +
+        # two joins) SIGKILLs the remote TPU compile helper as one program
+        n = 2 if rel.join_type in ("SEMI", "ANTI") else 1
+    elif isinstance(rel, (LogicalAggregate, LogicalWindow)):
+        n = 1
+    else:
+        n = 0
     return n + sum(_heavy_count(i) for i in rel.inputs)
 
 
