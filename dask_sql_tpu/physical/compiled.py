@@ -1944,18 +1944,21 @@ SMALL_FETCH_BYTES = 8 << 20
 
 def _compact_eligible(plan: RelNode) -> set:
     """ids of LogicalFilter nodes worth compacting: the TOPMOST filter of
-    each filter chain that has at least one join/aggregate/window ancestor
-    (the compaction pays for itself through the heavy op's sorts)."""
+    each filter chain with a SORT-SHAPED ancestor above — a join, window,
+    or grouped aggregate, whose in-program sorts shrink with the row
+    count.  A global aggregate is masked reductions only: compacting under
+    it is pure gather overhead (TPC-H Q6 measured 0.15 s -> 0.61 s)."""
     out: set = set()
 
-    def walk(rel: RelNode, heavy_above: bool, parent_is_filter: bool):
+    def walk(rel: RelNode, sorty_above: bool, parent_is_filter: bool):
         is_filter = isinstance(rel, LogicalFilter)
-        if is_filter and heavy_above and not parent_is_filter:
+        if is_filter and sorty_above and not parent_is_filter:
             out.add(id(rel))
-        heavy = heavy_above or isinstance(
-            rel, (LogicalJoin, LogicalAggregate, LogicalWindow))
+        sorty = sorty_above \
+            or isinstance(rel, (LogicalJoin, LogicalWindow, LogicalSort)) \
+            or (isinstance(rel, LogicalAggregate) and rel.group_keys)
         for i in rel.inputs:
-            walk(i, heavy, is_filter)
+            walk(i, sorty, is_filter)
 
     walk(plan, False, False)
     return out
