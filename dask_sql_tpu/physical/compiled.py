@@ -1954,9 +1954,13 @@ def _compact_eligible(plan: RelNode) -> set:
         is_filter = isinstance(rel, LogicalFilter)
         if is_filter and sorty_above and not parent_is_filter:
             out.add(id(rel))
+        # global DISTINCT aggregates still sort in-program on TPU
+        # (_traced_factorize -> _group_sorted_codes), so they count
         sorty = sorty_above \
             or isinstance(rel, (LogicalJoin, LogicalWindow, LogicalSort)) \
-            or (isinstance(rel, LogicalAggregate) and rel.group_keys)
+            or (isinstance(rel, LogicalAggregate)
+                and (rel.group_keys
+                     or any(a.distinct for a in rel.aggs)))
         for i in rel.inputs:
             walk(i, sorty, is_filter)
 
@@ -2074,10 +2078,19 @@ _SPLIT_SCHEMA = "__split__"
 
 def _heavy_count(rel: RelNode) -> int:
     if isinstance(rel, LogicalJoin):
-        # SEMI/ANTI lower through the payload exist-test formulation whose
-        # compile cost dwarfs a plain equi-join — TPC-H Q21 (two of them +
-        # two joins) SIGKILLs the remote TPU compile helper as one program
-        n = 2 if rel.join_type in ("SEMI", "ANTI") else 1
+        # SEMI/ANTI with a non-equi residual lower through the payload
+        # exist-test formulation whose compile cost dwarfs a plain
+        # equi-join — TPC-H Q21 (two of them + two joins) SIGKILLs the
+        # remote TPU compile helper as one program.  Plain equi SEMI/ANTI
+        # (Q4/Q20) compile like ordinary joins and keep weight 1.  The
+        # residual test is the SAME decomposition the lowering uses
+        # (_extract_equi_keys), so heuristic and lowering cannot drift.
+        from .rel.executor import _extract_equi_keys
+        n = 1
+        if rel.join_type in ("SEMI", "ANTI") and rel.condition is not None:
+            _, residual = _extract_equi_keys(rel)
+            if residual:
+                n = 2
     elif isinstance(rel, (LogicalAggregate, LogicalWindow)):
         n = 1
     else:
