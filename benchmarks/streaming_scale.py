@@ -170,6 +170,12 @@ def _frames_equal(a, b) -> bool:
 def main():
     os.environ.setdefault("XLA_FLAGS",
                           "--xla_force_host_platform_device_count=8")
+    # persistent XLA cache: the 8-device GSPMD programs cost minutes each
+    # to compile on this host — a rerun (or a crash-restart) must not
+    # re-pay them.  Same-machine only (micro-arch-specific executables).
+    os.environ.setdefault(
+        "DSQL_XLA_CACHE",
+        os.path.join(tempfile.gettempdir(), "dsql_stream_scale_xla"))
     import jax
 
     jax.config.update("jax_platforms", "cpu")

@@ -1954,13 +1954,15 @@ def _compact_eligible(plan: RelNode) -> set:
         is_filter = isinstance(rel, LogicalFilter)
         if is_filter and sorty_above and not parent_is_filter:
             out.add(id(rel))
-        # global DISTINCT aggregates still sort in-program on TPU
-        # (_traced_factorize -> _group_sorted_codes), so they count
+        # global DISTINCT aggregates (except MIN/MAX, which are
+        # dedup-invariant and skip _distinct_keep) still sort in-program
+        # on TPU (_traced_factorize -> _group_sorted_codes), so they count
         sorty = sorty_above \
             or isinstance(rel, (LogicalJoin, LogicalWindow, LogicalSort)) \
             or (isinstance(rel, LogicalAggregate)
                 and (rel.group_keys
-                     or any(a.distinct for a in rel.aggs)))
+                     or any(a.distinct and a.op not in ("MIN", "MAX")
+                            for a in rel.aggs)))
         for i in rel.inputs:
             walk(i, sorty, is_filter)
 

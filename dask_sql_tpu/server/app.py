@@ -190,7 +190,7 @@ def _make_handler(state: _AppState, base_url: str):
                 except Exception as e:
                     del state.future_list[uid]
                     state.query_info.pop(uid, None)
-                    self._send(200, _error_payload(str(e), uid))
+                    self._send(200, _error_payload(str(e), uid, exc=e))
                     return
                 del state.future_list[uid]
                 state.query_info.pop(uid, None)
@@ -241,14 +241,24 @@ def _make_handler(state: _AppState, base_url: str):
     return Handler
 
 
-def _error_payload(message: str, uid: str) -> dict:
-    """reference responses.py:119-139 ErrorResults shape."""
+def _error_payload(message: str, uid: str, exc: Exception = None) -> dict:
+    """reference responses.py:119-139 ErrorResults shape: the reference's
+    QueryError fills errorLocation from the parse error's position
+    (``error.from_line + 1``/``from_col + 1``); our ParsingException
+    carries 1-based (line, col) directly."""
+    line = getattr(exc, "line", None)
+    col = getattr(exc, "col", None)
     return {
         "id": uid, "infoUri": "", "stats": _stats("FAILED"),
         "error": {
-            "message": message, "errorCode": 1,
-            "errorName": "GENERIC_ERROR", "errorType": "USER_ERROR",
-            "errorLocation": {"lineNumber": 1, "columnNumber": 1},
+            "message": message, "errorCode": 0,
+            "errorName": str(type(exc)) if exc is not None
+            else "GENERIC_ERROR",
+            "errorType": "USER_ERROR",
+            "errorLocation": {
+                "lineNumber": line if isinstance(line, int) else 1,
+                "columnNumber": col if isinstance(col, int) else 1,
+            },
         },
     }
 

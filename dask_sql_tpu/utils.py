@@ -54,6 +54,10 @@ class ParsingException(Exception):
                  length: int = 1):
         self.sql = sql
         self.raw_message = message
+        # 1-based position, consumed by the Presto server's errorLocation
+        # (the reference exposes from_line/from_col the same way)
+        self.line = line
+        self.col = col
         if line is not None and sql:
             lines = sql.splitlines()
             if 0 < line <= len(lines):
