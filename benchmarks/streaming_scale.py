@@ -211,7 +211,26 @@ def main():
         d.nbytes + (m.nbytes if m is not None else 0)
         for b in source.batches for d, m in b)
 
+    # RESUME: fold in queries certified by a previous (complete or partial)
+    # run over the SAME data — the virtual-mesh GSPMD execution runs at
+    # simulator speed on this 1-core host, so one process may not fit every
+    # query inside a caller's timeout; accumulation is what makes the
+    # artifact completable at all
     results = {}
+    for prev in (OUT, OUT + ".partial"):
+        try:
+            with open(prev) as f:
+                d = json.load(f)
+            if (d.get("sf") == SF and d.get("lineitem_rows") == li_rows
+                    and d.get("batch_rows") == BATCH_ROWS):
+                for k, v in d.get("queries", {}).items():
+                    if "error" not in v:
+                        results.setdefault(int(k), v)
+        except (OSError, ValueError):
+            pass
+    if results:
+        print(f"resuming with prior results for {sorted(results)}",
+              flush=True)
 
     def _write(done=False):
         artifact = {
@@ -249,6 +268,8 @@ def main():
         return artifact
 
     for qid in QIDS:
+        if qid in results:
+            continue
         rec = {}
         try:
             want_path = os.path.join(DATA_DIR, f"oracle_q{qid}.feather")

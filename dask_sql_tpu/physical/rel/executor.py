@@ -179,34 +179,10 @@ def _run_udaf(agg: AggCall, col, codes, num_groups, filter_mask, src: Table) -> 
                   None if mask.all() else jnp.asarray(mask))
 
 
-def _extract_equi_keys(rel: LogicalJoin):
-    """Split the join condition into equi-key pairs + residual rex
-    (reference: _split_join_condition join.py:245-284)."""
-    nl = len(rel.left.schema)
-    equi: List[tuple] = []
-    residual: List = []
-
-    def visit(rex):
-        if isinstance(rex, RexCall) and rex.op == "AND":
-            visit(rex.operands[0])
-            visit(rex.operands[1])
-            return
-        if isinstance(rex, RexCall) and rex.op == "=" and len(rex.operands) == 2:
-            a, b = rex.operands
-            if isinstance(a, RexInputRef) and isinstance(b, RexInputRef):
-                if a.index < nl <= b.index:
-                    equi.append((a.index, b.index - nl))
-                    return
-                if b.index < nl <= a.index:
-                    equi.append((b.index, a.index - nl))
-                    return
-        if isinstance(rex, RexLiteral) and rex.value is True:
-            return
-        residual.append(rex)
-
-    if rel.condition is not None:
-        visit(rel.condition)
-    return equi, residual
+# the splitter lives in the PLAN layer (optimizer passes need it too, and
+# plan -> physical imports would invert the layering); aliased here for the
+# physical-layer call sites
+from ...plan.optimizer import split_join_condition as _extract_equi_keys  # noqa: E402,E501
 
 
 def _join(rel: LogicalJoin, ex: RelExecutor) -> Table:

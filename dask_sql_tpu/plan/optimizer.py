@@ -713,6 +713,145 @@ def push_join_side_conditions(rel: RelNode) -> RelNode:
     return out
 
 
+def split_join_condition(rel: LogicalJoin):
+    """Split a join condition into equi-key pairs + residual rex
+    (reference: _split_join_condition join.py:245-284).  Shared by the
+    physical executors AND the optimizer's exist-test rewrite — one
+    decomposition, so heuristics and lowerings cannot drift."""
+    nl = len(rel.left.schema)
+    equi: List[tuple] = []
+    residual: List = []
+
+    def visit(rex):
+        if isinstance(rex, RexCall) and rex.op == "AND":
+            visit(rex.operands[0])
+            visit(rex.operands[1])
+            return
+        if isinstance(rex, RexCall) and rex.op == "=" and len(rex.operands) == 2:
+            a, b = rex.operands
+            if isinstance(a, RexInputRef) and isinstance(b, RexInputRef):
+                if a.index < nl <= b.index:
+                    equi.append((a.index, b.index - nl))
+                    return
+                if b.index < nl <= a.index:
+                    equi.append((b.index, a.index - nl))
+                    return
+        if isinstance(rex, RexLiteral) and rex.value is True:
+            return
+        residual.append(rex)
+
+    if rel.condition is not None:
+        visit(rel.condition)
+    return equi, residual
+
+
+_EXIST_TEST_OPS = {"<>", "<", "<=", ">", ">="}
+_EXIST_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "<>": "<>"}
+
+
+def rewrite_exist_test_joins(rel: RelNode) -> RelNode:
+    """SEMI/ANTI with equi keys plus ONE build-vs-probe comparison residual
+    (TPC-H Q21's ``EXISTS(l2.orderkey = l1.orderkey AND l2.suppkey <>
+    l1.suppkey)``) — the compiled executor's in-join exist-test payload
+    formulation for this shape produces XLA:TPU programs so large the
+    remote compile helper is OOM-killed.  Algebraic equivalent: group the
+    build by the equi keys with COUNT(x)/MIN(x)/MAX(x), then
+        exists b.x <> y  <=>  cnt >= 1 AND (mn <> y OR mx <> y)
+        exists b.x <  y  <=>  cnt >= 1 AND mn < y       (etc. via min/max)
+    so the SEMI becomes a plain INNER equi join + filter, and the ANTI a
+    LEFT join + null-aware filter — both compile like ordinary joins.
+    Floats are excluded (NaN comparison semantics the min/max reduction
+    cannot reproduce), matching the exist-test path's own restriction."""
+    new_inputs = [rewrite_exist_test_joins(i) for i in rel.inputs]
+    if any(a is not b for a, b in zip(new_inputs, rel.inputs)):
+        rel = rel.with_inputs(new_inputs)
+    if not isinstance(rel, LogicalJoin) \
+            or rel.join_type not in ("SEMI", "ANTI") \
+            or getattr(rel, "null_aware", False) \
+            or rel.condition is None:
+        return rel
+    equi, residual = split_join_condition(rel)
+    if not equi or len(residual) != 1:
+        return rel
+    r = residual[0]
+    nl = len(rel.left.schema)
+    if not (isinstance(r, RexCall) and r.op in _EXIST_TEST_OPS
+            and len(r.operands) == 2
+            and all(isinstance(o, RexInputRef) for o in r.operands)):
+        return rel
+    a, b = r.operands
+    if a.index < nl <= b.index:
+        y_idx, x_idx, op = a.index, b.index - nl, _EXIST_FLIP[r.op]
+    elif b.index < nl <= a.index:
+        y_idx, x_idx, op = b.index, a.index - nl, r.op
+    else:
+        return rel
+    from ..types import BIGINT
+
+    right = rel.right
+    x_f = right.schema[x_idx]
+    y_f = rel.left.schema[y_idx]
+    if x_f.stype.is_floating or y_f.stype.is_floating:
+        return rel
+    gks = []
+    for _, bi in equi:
+        if bi not in gks:
+            gks.append(bi)
+    key_fields = [Field(right.schema[bi].name, right.schema[bi].stype)
+                  for bi in gks]
+    agg = LogicalAggregate(
+        input=right, group_keys=list(gks),
+        aggs=[AggCall("COUNT", [x_idx], False, BIGINT, "cnt$"),
+              AggCall("MIN", [x_idx], False, x_f.stype, "mn$"),
+              AggCall("MAX", [x_idx], False, x_f.stype, "mx$")],
+        schema=key_fields + [Field("cnt$", BIGINT),
+                             Field("mn$", x_f.stype),
+                             Field("mx$", x_f.stype)])
+    pos_of = {bi: i for i, bi in enumerate(gks)}
+    cond = None
+    for pi, bi in equi:
+        eq = RexCall("=", [RexInputRef(pi, rel.left.schema[pi].stype),
+                           RexInputRef(nl + pos_of[bi],
+                                       right.schema[bi].stype)], BOOLEAN)
+        cond = eq if cond is None else RexCall("AND", [cond, eq], BOOLEAN)
+    nk = len(gks)
+    joined = LogicalJoin(
+        left=rel.left, right=agg,
+        join_type="INNER" if rel.join_type == "SEMI" else "LEFT",
+        condition=cond, schema=list(rel.left.schema) + list(agg.schema))
+    y = RexInputRef(y_idx, y_f.stype)
+    cnt = RexInputRef(nl + nk, BIGINT)
+    mn = RexInputRef(nl + nk + 1, x_f.stype)
+    mx = RexInputRef(nl + nk + 2, x_f.stype)
+    if op == "<>":
+        pred = RexCall("OR", [RexCall("<>", [mn, y], BOOLEAN),
+                              RexCall("<>", [mx, y], BOOLEAN)], BOOLEAN)
+    elif op in ("<", "<="):
+        pred = RexCall(op, [mn, y], BOOLEAN)
+    else:
+        pred = RexCall(op, [mx, y], BOOLEAN)
+    cnt_pos = RexCall(">=", [RexCall("COALESCE",
+                                     [cnt, RexLiteral(0, BIGINT)], BIGINT),
+                             RexLiteral(1, BIGINT)], BOOLEAN)
+    exists_pred = RexCall("AND", [cnt_pos, pred], BOOLEAN)
+    if rel.join_type == "SEMI":
+        keep: RexNode = exists_pred
+    else:
+        # NOT EXISTS keeps the row when the group is absent, when the
+        # probe value is NULL (no comparison can succeed), or when no
+        # build value satisfies the comparison — 3VL-safe by construction
+        keep = RexCall("OR", [
+            RexCall("IS_NULL", [y], BOOLEAN),
+            RexCall("NOT", [exists_pred], BOOLEAN)], BOOLEAN)
+    filt = LogicalFilter(input=joined, condition=keep,
+                         schema=list(joined.schema))
+    return LogicalProject(
+        input=filt,
+        exprs=[RexInputRef(i, f.stype)
+               for i, f in enumerate(rel.left.schema)],
+        schema=list(rel.schema))
+
+
 _AGG_THROUGH_JOIN_OPS = {"COUNT", "SUM", "$SUM0", "MIN", "MAX"}
 
 
@@ -816,6 +955,7 @@ def aggregate_through_join(rel: RelNode) -> RelNode:
 PASSES = [merge_filters, factor_or_predicates, push_filters, merge_filters,
           reorder_joins, push_filters, merge_filters,
           push_join_side_conditions, push_filters, merge_filters,
+          rewrite_exist_test_joins,
           aggregate_through_join, merge_projects]
 
 

@@ -341,3 +341,32 @@ def test_decimal_multiply_precision():
         return_futures=False)
     assert abs(float(got["rev"][0]) - (19.99 * 3 + 5.25 * 7 + 100.01 * 2)) \
         < 1e-9
+
+
+def test_correlated_exists_nonequi_residual_int():
+    """EXISTS with an equi key + integer non-equi residual — the shape the
+    optimizer rewrites to a grouped MIN/MAX join (TPC-H Q21's; the in-join
+    exist-test formulation OOM-killed the TPU compile helper).  Randomized
+    against sqlite for <>, <, > in both SEMI and ANTI polarity."""
+    a = make_rand_df(40, k=(int, 5), x=int, va=float)
+    b = make_rand_df(50, k=(int, 5), x=int, vb=float)
+    for op in ("<>", "<", ">", "<=", ">="):
+        eq_sqlite(
+            f"SELECT k, x, va FROM a WHERE EXISTS (SELECT 1 FROM b "
+            f"WHERE b.k = a.k AND b.x {op} a.x)", a=a, b=b)
+        eq_sqlite(
+            f"SELECT k, x, va FROM a WHERE NOT EXISTS (SELECT 1 FROM b "
+            f"WHERE b.k = a.k AND b.x {op} a.x)", a=a, b=b)
+
+
+def test_correlated_exists_nonequi_all_null_build_group():
+    # a build group whose x is entirely NULL can satisfy no comparison:
+    # EXISTS false, NOT EXISTS keeps the row (COUNT(x)-guard in the
+    # rewrite; sqlite agrees)
+    a = pd.DataFrame({"k": [1, 2, 3], "x": [10, 20, 30]})
+    b = pd.DataFrame({"k": [1, 1, 2],
+                      "x": pd.array([None, None, 25], dtype="Int64")})
+    eq_sqlite("SELECT k FROM a WHERE EXISTS (SELECT 1 FROM b "
+              "WHERE b.k = a.k AND b.x <> a.x)", a=a, b=b)
+    eq_sqlite("SELECT k FROM a WHERE NOT EXISTS (SELECT 1 FROM b "
+              "WHERE b.k = a.k AND b.x <> a.x)", a=a, b=b)
