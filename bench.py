@@ -546,8 +546,8 @@ def main():
                         os.path.join(cache_root, "caps.json"))
 
     def journal_state():
-        """(measured, warm_failed) query-id sets from the progress file."""
-        got, failed = set(), set()
+        """(measured set, warm-failure counts) from the progress file."""
+        got, failed = set(), {}
         with open(progress) as f:
             for line in f:
                 try:
@@ -557,15 +557,19 @@ def main():
                 if "q" in rec:
                     got.add(rec["q"])
                 elif "warm_fail" in rec:
-                    failed.add(rec["warm_fail"])
+                    failed[rec["warm_fail"]] = \
+                        failed.get(rec["warm_fail"], 0) + 1
         return got, failed
 
     attempt = 0
     max_attempts = int(os.environ.get("BENCH_MAX_CHILDREN", "3"))
     while attempt < max_attempts:
         got, failed = journal_state()
-        # a warmup that already failed won't succeed on relaunch — exclude
-        remaining_q = [q for q in qids if q not in got and q not in failed]
+        # compile failures over the tunnel are often TRANSIENT (the remote
+        # helper gets OOM-killed under load): one retry in a fresh child;
+        # two strikes is a real verdict
+        remaining_q = [q for q in qids
+                       if q not in got and failed.get(q, 0) < 2]
         budget_left = deadline - EMIT_MARGIN - time.monotonic()
         if not remaining_q or budget_left < MIN_CHILD_BUDGET:
             break
