@@ -212,39 +212,66 @@ class Context:
             for df_name, df in dataframes.items():
                 self.create_table(df_name, df, gpu=gpu)
 
+        # per-call wall breakdown, overwritten by every sql() call: over a
+        # remote TPU the interesting split is host planning vs the (single)
+        # device round trip vs host decode — bench.py journals this so a
+        # slow query names its own bottleneck
+        import time as _time
+        t0 = _time.perf_counter()
+        stmts = parse_sql(sql)
+        timings = {"parse_ms": (_time.perf_counter() - t0) * 1e3,
+                   "plan_ms": 0.0, "exec_ms": 0.0, "fetch_ms": 0.0}
+        self.last_timings = timings
         result = None
-        for stmt in parse_sql(sql):
+        for stmt in stmts:
             result = self._execute_statement(stmt, sql)
         if result is None:
             result = Table([], [])
         if not return_futures and isinstance(result, Table):
-            return result.to_pandas()
+            t0 = _time.perf_counter()
+            result = result.to_pandas()
+            timings["fetch_ms"] = (_time.perf_counter() - t0) * 1e3
+            return result
         return result
 
     def _execute_statement(self, stmt: A.Statement, sql: str):
         from .physical.rel.custom import StatementDispatcher
-        from .physical.rel.executor import RelExecutor
 
+        import time as _time
+        timings = getattr(self, "last_timings", None)
         if isinstance(stmt, A.QueryStatement):
+            t0 = _time.perf_counter()
             plan = self._get_plan(stmt.query, sql)
-            # out-of-HBM tables route through the streaming executor — the
-            # resident paths below must never compute on their binding stubs.
-            # (_has_chunked guards the per-query plan walk + import: contexts
-            # that never registered a chunked table skip it entirely)
-            if self._has_chunked:
-                from .physical.streaming import (execute_streaming,
-                                                 plan_references_chunked)
-                if plan_references_chunked(plan, self):
-                    return execute_streaming(plan, self)
-            # whole-plan jit (one device dispatch per query); falls back to
-            # the eager per-op executor for plan shapes outside its subset
-            from .physical.compiled import try_execute_compiled
-            result = try_execute_compiled(plan, self)
-            if result is not None:
-                return result
-            return RelExecutor(self).execute(plan)
+            if timings is not None:
+                timings["plan_ms"] += (_time.perf_counter() - t0) * 1e3
+                t0 = _time.perf_counter()
+                try:
+                    return self._execute_query_plan(plan)
+                finally:
+                    timings["exec_ms"] += (_time.perf_counter() - t0) * 1e3
+            return self._execute_query_plan(plan)
         handler = StatementDispatcher.get_plugin(type(stmt).__name__)
         return handler(stmt, self, sql)
+
+    def _execute_query_plan(self, plan):
+        from .physical.rel.executor import RelExecutor
+
+        # out-of-HBM tables route through the streaming executor — the
+        # resident paths below must never compute on their binding stubs.
+        # (_has_chunked guards the per-query plan walk + import: contexts
+        # that never registered a chunked table skip it entirely)
+        if self._has_chunked:
+            from .physical.streaming import (execute_streaming,
+                                             plan_references_chunked)
+            if plan_references_chunked(plan, self):
+                return execute_streaming(plan, self)
+        # whole-plan jit (one device dispatch per query); falls back to
+        # the eager per-op executor for plan shapes outside its subset
+        from .physical.compiled import try_execute_compiled
+        result = try_execute_compiled(plan, self)
+        if result is not None:
+            return result
+        return RelExecutor(self).execute(plan)
 
     def _get_plan(self, query: A.SelectLike, sql: str = "") -> RelNode:
         binder = Binder(self, sql)

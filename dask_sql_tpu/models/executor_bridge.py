@@ -7,7 +7,9 @@ from ..table import Table
 
 
 def run_query(context, query_ast, sql: str) -> Table:
-    from ..physical.rel.executor import RelExecutor
-
     plan = context._get_plan(query_ast, sql)
-    return RelExecutor(context).execute(plan)
+    # the full execution route, NOT a direct RelExecutor call: a chunked
+    # (out-of-HBM) source must go through the streaming executor — the eager
+    # executor would silently compute on its 1-row binding stub — and
+    # resident plans get the whole-plan compiled path
+    return context._execute_query_plan(plan)

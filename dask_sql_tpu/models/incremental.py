@@ -1,0 +1,195 @@
+"""Out-of-core (incremental) training and batched prediction.
+
+Reference parity: ``CREATE MODEL (wrap_fit = True, ...)`` wraps the estimator
+in dask-ml ``Incremental`` so training streams partition-by-partition via
+``partial_fit`` (/root/reference/dask_sql/physical/rel/custom/
+create_model.py:141-155); ``wrap_predict`` wraps it in ``ParallelPostFit``
+for partitioned prediction (:147-155).
+
+The TPU-first analogue: the training SELECT's row-local plan (projections /
+filters / resident-side joins above ONE chunked scan) executes per host
+batch through the same compile-once streaming machinery queries use
+(physical/streaming.py — every batch is padded to identical shapes, so one
+XLA program serves all batches), and each batch's host frame feeds
+``partial_fit``.  No more than one batch is device- or host-materialized at
+a time.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Iterator, List
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def iter_query_batches(context, plan) -> Iterator:
+    """Yield the query result as per-batch ``Table``s (result row-stream).
+
+    Requires the plan to be a row-stream over exactly one chunked scan: no
+    blocking operator (aggregate / sort / window) on the scan's path, so the
+    concatenation of per-batch results IS the query result.  Off-path
+    subtrees (e.g. resident join sides) are materialized once by the
+    streaming rewriter.
+    """
+    from ..physical import streaming as S
+    from ..plan.nodes import (LogicalAggregate, LogicalExcept,
+                              LogicalIntersect, LogicalSort, LogicalUnion,
+                              LogicalWindow)
+
+    scans = S._chunked_scans(plan, context)
+    if len(scans) != 1:
+        raise S.StreamingUnsupported(
+            f"incremental training needs exactly one chunked table in the "
+            f"training query (found {len(scans)})")
+    scan = scans[0]
+    path = S._path_to(plan, scan)
+    if path is None:
+        raise S.StreamingUnsupported(
+            "chunked table referenced inside a scalar subquery cannot "
+            "stream training batches")
+    for node in path[:-1]:
+        # blocking operators make the result not-a-row-stream; set
+        # operators would replay their resident branch into EVERY batch
+        # (and dedup semantics don't distribute over batches)
+        if isinstance(node, (LogicalAggregate, LogicalSort, LogicalWindow,
+                             LogicalUnion, LogicalIntersect, LogicalExcept)):
+            raise S.StreamingUnsupported(
+                f"{type(node).__name__} above the chunked scan makes the "
+                "training query a blocking computation, not a row-stream; "
+                "materialize it into a resident table first or drop "
+                "wrap_fit")
+    entry = context.schema[scan.schema_name].tables[scan.table_name]
+    source = entry.chunked
+    partial = S._stream_partial_plans(plan, scan, path, context)
+    names = [f.name for f in plan.schema]
+    try:
+        for bi in range(source.n_batches):
+            table, row_valid = source.batch_table(bi)
+            S._set_batch_entry(context, table, row_valid)
+            result = S._run_resident(partial, context)
+            yield result.with_names(names)
+    finally:
+        S._cleanup(context)
+
+
+def incremental_fit(model, context, plan, target_column: str,
+                    fit_kwargs: dict) -> List[str]:
+    """Stream the training query batch-by-batch through ``partial_fit``.
+
+    Returns the feature column names.  Classifiers need the full label set
+    on the FIRST ``partial_fit`` call; when the caller did not provide
+    ``classes`` in fit_kwargs, a cheap label-only prescan collects it
+    (mirrors dask-ml's requirement that Incremental classifiers get
+    ``classes`` up front).
+    """
+    fit_kwargs = dict(fit_kwargs)
+    try:
+        from sklearn.base import is_classifier as _is_clf
+        clf = _is_clf(model)
+    except ImportError:
+        # non-sklearn estimators: the legacy marker is the only signal
+        clf = getattr(model, "_estimator_type", "") == "classifier"
+    if clf and target_column and "classes" not in fit_kwargs:
+        # prescan a LABEL-ONLY projection of the plan: running the full
+        # training query twice would double device compute and transfer
+        from ..plan.nodes import Field, LogicalProject, RexInputRef
+        tgt = next(i for i, f in enumerate(plan.schema)
+                   if f.name == target_column)
+        label_plan = LogicalProject(
+            input=plan, exprs=[RexInputRef(tgt, plan.schema[tgt].stype)],
+            schema=[Field(target_column, plan.schema[tgt].stype)])
+        seen = set()
+        for t in iter_query_batches(context, label_plan):
+            col = t.column(target_column)
+            seen.update(np.unique(col.to_numpy()).tolist())
+        fit_kwargs["classes"] = np.sort(np.asarray(sorted(seen)))
+        logger.info("incremental fit: prescanned %d classes",
+                    len(fit_kwargs["classes"]))
+
+    from .training import _all_numeric
+    feature_names: List[str] = []
+    n_batches = 0
+    for t in iter_query_batches(context, plan):
+        df = t.to_pandas()
+        if target_column:
+            y = df[target_column].to_numpy()
+            X = df.drop(columns=[target_column])
+        else:
+            y = None
+            X = df
+        feature_names = X.columns.tolist()
+        Xn = (X.to_numpy(dtype=np.float64, na_value=np.nan)
+              if _all_numeric(X) else X)
+        if y is not None:
+            model.partial_fit(Xn, y, **fit_kwargs)
+        else:
+            model.partial_fit(Xn, **fit_kwargs)
+        # classes only feeds the first call on sklearn classifiers, but
+        # passing it again is accepted; transformers (no y) take none
+        n_batches += 1
+    if n_batches == 0:
+        # match the gathered path, where sklearn's fit raises on empty
+        # input at CREATE MODEL time — never register an unfit estimator
+        raise ValueError(
+            "incremental training source produced no batches (empty "
+            "chunked table?); refusing to register an unfit model")
+    logger.info("incremental fit: %d partial_fit batches", n_batches)
+    return feature_names
+
+
+class BatchedPredictor:
+    """``wrap_predict`` analogue of dask-ml ParallelPostFit (reference
+    create_model.py:147-155): prediction runs in bounded host batches so a
+    table-sized feature matrix is never scored in one call.  Delegates
+    everything else to the wrapped estimator; picklable for EXPORT MODEL."""
+
+    #: rows per predict slice — bounds peak memory of model.predict
+    batch_rows = 1 << 20
+
+    def __init__(self, model, batch_rows: int = None):
+        self.model = model
+        if batch_rows is not None:
+            self.batch_rows = int(batch_rows)
+
+    def _batched(self, method: str, X):
+        fn = getattr(self.model, method)
+        n = len(X)
+        if n <= self.batch_rows:
+            return fn(X)
+        parts = []
+        for s in range(0, n, self.batch_rows):
+            part = X[s:s + self.batch_rows] if not hasattr(X, "iloc") \
+                else X.iloc[s:s + self.batch_rows]
+            parts.append(np.asarray(fn(part)))
+        return np.concatenate(parts)
+
+    # every scoring surface ParallelPostFit wraps is batched, not just
+    # predict — the memory bound must hold for probabilities too
+    def predict(self, X):
+        return self._batched("predict", X)
+
+    def predict_proba(self, X):
+        return self._batched("predict_proba", X)
+
+    def predict_log_proba(self, X):
+        return self._batched("predict_log_proba", X)
+
+    def decision_function(self, X):
+        return self._batched("decision_function", X)
+
+    def transform(self, X):
+        return self._batched("transform", X)
+
+    def __getattr__(self, name):
+        # delegation target; __getattr__ only fires for attributes not on
+        # the wrapper itself, so the batched methods above stay ours
+        return getattr(self.model, name)
+
+    def __getstate__(self):
+        return {"model": self.model, "batch_rows": self.batch_rows}
+
+    def __setstate__(self, state):
+        self.model = state["model"]
+        self.batch_rows = state["batch_rows"]

@@ -60,13 +60,29 @@ def create_model(stmt: A.CreateModel, context, sql: str):
 
     ModelClass = import_class(model_class)
     model = ModelClass(**kwargs)
-    # dask-ml Incremental/ParallelPostFit wrappers (reference
-    # create_model.py:141-155) are meaningless on a single device table; the
-    # flags are accepted for API parity and ignored.
-    del wrap_predict, wrap_fit
 
-    from .executor_bridge import run_query
-    training_table = run_query(context, stmt.query, sql)
+    # wrap_fit over an out-of-HBM source: stream partial_fit batch-by-batch
+    # (reference wraps in dask-ml Incremental, create_model.py:141-155).
+    # Over a resident table the whole training set already fits on device,
+    # so plain fit IS the single-partition Incremental semantics.
+    plan = context._get_plan(stmt.query, sql)
+    from ..physical.streaming import plan_references_chunked
+    if wrap_fit and plan_references_chunked(plan, context):
+        if not hasattr(model, "partial_fit"):
+            raise AttributeError(
+                f"wrap_fit=True over a chunked table needs an estimator "
+                f"with partial_fit; {model_class} has none")
+        from .incremental import incremental_fit
+        feature_names = incremental_fit(model, context, plan,
+                                        target_column, fit_kwargs)
+        if wrap_predict:
+            from .incremental import BatchedPredictor
+            model = BatchedPredictor(model)
+        context.register_model(name, model, feature_names,
+                               schema_name=schema_name)
+        return None
+
+    training_table = context._execute_query_plan(plan)
     X, y = _gather_xy(training_table, target_column)
     if y is not None:
         model.fit(X.to_numpy(dtype=np.float64, na_value=np.nan)
@@ -74,6 +90,9 @@ def create_model(stmt: A.CreateModel, context, sql: str):
     else:
         model.fit(X.to_numpy(dtype=np.float64, na_value=np.nan)
                   if _all_numeric(X) else X, **fit_kwargs)
+    if wrap_predict:
+        from .incremental import BatchedPredictor
+        model = BatchedPredictor(model)
     context.register_model(name, model, X.columns.tolist(), schema_name=schema_name)
     return None
 
