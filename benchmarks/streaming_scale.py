@@ -60,8 +60,13 @@ N_PIECES = int(os.environ.get("STREAM_SCALE_PIECES",
 DATA_DIR = os.environ.get("STREAM_SCALE_DATA",
                           os.path.join(tempfile.gettempdir(),
                                        f"stream_scale_sf{SF:g}"))
-OUT = (os.path.join(_REPO, "STREAMING_r04.json")
+OUT = (os.path.join(_REPO, "STREAMING_r05.json")
        if SF >= 3 else "/tmp/streaming_smoke.json")
+# prior rounds' artifacts: resumable accumulation reads these too (same
+# SF/rows/batch check as any resume source), so a new round re-certifies
+# only what it must
+_PRIOR = [os.path.join(_REPO, "STREAMING_r04.json"),
+          os.path.join(_REPO, "STREAMING_r04.json.partial")]
 
 # lineitem columns each oracle query touches (loading all 16 at SF 10 is
 # the difference between a 4 GB and a 10 GB oracle subprocess)
@@ -172,10 +177,23 @@ def main():
                           "--xla_force_host_platform_device_count=8")
     # persistent XLA cache: the 8-device GSPMD programs cost minutes each
     # to compile on this host — a rerun (or a crash-restart) must not
-    # re-pay them.  Same-machine only (micro-arch-specific executables).
+    # re-pay them.  The dir name carries a CPU-feature fingerprint (same
+    # scheme as tests/conftest.py): XLA:CPU AOT executables are micro-arch
+    # specific, and /tmp can survive into a round that runs on a DIFFERENT
+    # machine — loading a foreign executable warns "could lead to
+    # execution errors such as SIGILL" and sometimes does exactly that.
+    import hashlib as _hashlib
+    try:
+        with open("/proc/cpuinfo") as _f:
+            _flags = "".join(sorted(l for l in _f if l.startswith("flags")))
+        _cpu_fp = _hashlib.blake2b(_flags.encode(),
+                                   digest_size=4).hexdigest()
+    except OSError:
+        _cpu_fp = "nocpuinfo"
     os.environ.setdefault(
         "DSQL_XLA_CACHE",
-        os.path.join(tempfile.gettempdir(), "dsql_stream_scale_xla"))
+        os.path.join(tempfile.gettempdir(),
+                     f"dsql_stream_scale_xla_{_cpu_fp}"))
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -217,7 +235,7 @@ def main():
     # query inside a caller's timeout; accumulation is what makes the
     # artifact completable at all
     results = {}
-    for prev in (OUT, OUT + ".partial"):
+    for prev in [OUT, OUT + ".partial"] + _PRIOR:
         try:
             with open(prev) as f:
                 d = json.load(f)
@@ -228,6 +246,11 @@ def main():
                         results.setdefault(int(k), v)
         except (OSError, ValueError):
             pass
+    # STREAM_SCALE_FORCE=6,... : drop these from the resume set so a query
+    # whose prior number should improve (engine change) re-certifies fresh
+    for q in os.environ.get("STREAM_SCALE_FORCE", "").split(","):
+        if q.strip():
+            results.pop(int(q), None)
     if results:
         print(f"resuming with prior results for {sorted(results)}",
               flush=True)

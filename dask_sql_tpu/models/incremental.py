@@ -62,9 +62,12 @@ def iter_query_batches(context, plan) -> Iterator:
                 "wrap_fit")
     entry = context.schema[scan.schema_name].tables[scan.table_name]
     source = entry.chunked
-    partial = S._stream_partial_plans(plan, scan, path, context)
     names = [f.name for f in plan.schema]
     try:
+        # inside the try: the rewriter materializes off-path resident
+        # subtrees into __stream__ temps as it goes, and a failure partway
+        # (e.g. a disallowed join shape deeper in) must not leak them
+        partial = S._stream_partial_plans(plan, scan, path, context)
         for bi in range(source.n_batches):
             table, row_valid = source.batch_table(bi)
             S._set_batch_entry(context, table, row_valid)
@@ -92,14 +95,22 @@ def incremental_fit(model, context, plan, target_column: str,
         # non-sklearn estimators: the legacy marker is the only signal
         clf = getattr(model, "_estimator_type", "") == "classifier"
     if clf and target_column and "classes" not in fit_kwargs:
-        # prescan a LABEL-ONLY projection of the plan: running the full
-        # training query twice would double device compute and transfer
+        # prescan a LABEL-ONLY projection of the plan, re-optimized so
+        # column pruning actually strips the unused feature columns and
+        # subtrees — otherwise the full training query's device compute
+        # would run twice
         from ..plan.nodes import Field, LogicalProject, RexInputRef
-        tgt = next(i for i, f in enumerate(plan.schema)
-                   if f.name == target_column)
-        label_plan = LogicalProject(
+        from ..plan.optimizer import optimize
+        tgt = next((i for i, f in enumerate(plan.schema)
+                    if f.name == target_column), None)
+        if tgt is None:
+            raise KeyError(
+                f"target_column {target_column!r} is not a column of the "
+                f"training query (have: "
+                f"{[f.name for f in plan.schema]})")
+        label_plan = optimize(LogicalProject(
             input=plan, exprs=[RexInputRef(tgt, plan.schema[tgt].stype)],
-            schema=[Field(target_column, plan.schema[tgt].stype)])
+            schema=[Field(target_column, plan.schema[tgt].stype)]))
         seen = set()
         for t in iter_query_batches(context, label_plan):
             col = t.column(target_column)

@@ -56,6 +56,9 @@ def load() -> Optional[ctypes.CDLL]:
         lib.dsql_parse.restype = ctypes.c_void_p  # keep pointer for dsql_free
         lib.dsql_free.argtypes = [ctypes.c_void_p]
         lib.dsql_free.restype = None
+        if hasattr(lib, "dsql_optimize"):
+            lib.dsql_optimize.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            lib.dsql_optimize.restype = ctypes.c_void_p
         _lib = lib
     except OSError as exc:
         logger.debug("native parser load failed: %s", exc)
@@ -78,6 +81,27 @@ def parse_to_json(sql: str) -> Optional[dict]:
     if lib is None:
         return None
     ptr = lib.dsql_parse(sql.encode("utf-8"))
+    if not ptr:
+        return None
+    try:
+        raw = ctypes.string_at(ptr)
+    finally:
+        lib.dsql_free(ptr)
+    return json.loads(raw.decode("utf-8"))
+
+
+def optimize_to_json(plan_json: str, enable_pruning: bool = True
+                     ) -> Optional[dict]:
+    """Optimize a serialized plan via the native library.
+
+    ``{"ok": <plan>}`` on success, ``{"error": {...}}`` on a native
+    failure, or None when the library (or entry point) is unavailable.
+    """
+    lib = load()
+    if lib is None or not hasattr(lib, "dsql_optimize"):
+        return None
+    ptr = lib.dsql_optimize(plan_json.encode("utf-8"),
+                            1 if enable_pruning else 0)
     if not ptr:
         return None
     try:

@@ -985,6 +985,20 @@ def optimize_subplans(rel: RelNode) -> RelNode:
 
 
 def optimize(plan: RelNode, enable_pruning: bool = True) -> RelNode:
+    """Rule pipeline; prefers the native (C++) optimizer when available.
+
+    native/optimizer.cpp is a lockstep port of every pass in this module
+    (the reference's planner runs its HepPlanner natively too,
+    RelationalAlgebraGenerator.java:97-224); this Python pipeline is the
+    fallback for plans carrying Python-only payloads (UDFs, custom
+    aggregations, PREDICT nodes) and the semantics reference the native
+    port is tested against (tests/unit/test_native_optimizer.py)."""
+    import os as _os
+    if _os.environ.get("DSQL_NATIVE", "1") != "0":
+        from .native_planner import optimize_native
+        native = optimize_native(plan, enable_pruning)
+        if native is not None:
+            return native
     for p in PASSES:
         plan = p(plan)
     plan = optimize_subplans(plan)

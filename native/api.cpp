@@ -8,12 +8,17 @@
 //     {"ok": <statement array>}  or
 //     {"error": {"msg": ..., "line": N, "col": N, "width": N}}
 //   The caller must release the result with dsql_free().
+//   dsql_optimize(plan_json, enable_pruning) -> malloc'd UTF-8 JSON string,
+//     {"ok": <optimized plan>} or {"error": {"msg": ...}} — the native rule
+//     optimizer (optimizer.cpp), lockstep with plan/optimizer.py.
 #include <cstdlib>
 #include <cstring>
 #include <string>
 
+#include "json.h"
 #include "lexer.h"
 #include "parser.h"
+#include "plan.h"
 
 namespace {
 
@@ -53,5 +58,20 @@ char* dsql_parse(const char* sql) {
 }
 
 void dsql_free(char* p) { std::free(p); }
+
+char* dsql_optimize(const char* plan_json, int enable_pruning) {
+  try {
+    dsql::JVP doc = dsql::json_parse(plan_json ? plan_json : "");
+    dsql::RelP plan = dsql::rel_from_json(doc);
+    dsql::RelP out = dsql::optimize_plan(plan, enable_pruning != 0);
+    return dup_string("{\"ok\":" + dsql::json_emit(dsql::rel_to_json(out)) +
+                      "}");
+  } catch (const std::exception& e) {
+    return dup_string(error_json(std::string("optimize: ") + e.what(), 1, 1,
+                                 1));
+  } catch (...) {
+    return dup_string(error_json("optimize: unknown error", 1, 1, 1));
+  }
+}
 
 }  // extern "C"
