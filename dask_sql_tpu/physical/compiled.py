@@ -2149,14 +2149,48 @@ def _execute_split(plan: RelNode, node: RelNode, context) -> Optional[Table]:
     sub = try_execute_compiled(node, context)  # may split again, recursively
     if sub is None:
         return None  # subtree not compilable: let the caller's policy run
-    # DETERMINISTIC temp name from the subtree's shape: the name feeds the
-    # OUTER program's plan fingerprint, so a per-execution counter would
-    # recompile the outer half on every run (and leak dead cache entries).
-    # Identical digests mean identical subplans over the same catalog —
-    # concurrent overwrite is then harmless (equal content).
+    # DETERMINISTIC temp name from the subtree's shape PLUS the scanned
+    # tables' uids: the name feeds the OUTER program's plan fingerprint, so
+    # a per-execution counter would recompile the outer half on every run
+    # (and leak dead cache entries) — but shape alone is not enough, since
+    # catalog data can mutate (INSERT / re-register) between two concurrent
+    # executions sharing a context.  With uids folded in, identical digests
+    # imply identical subplans over identical table OBJECTS, so a
+    # concurrent overwrite writes equal content and is harmless.
+    def _rex_scan_uids(rex) -> list:
+        from ..plan.nodes import RexCall as _RC
+        from ..plan.nodes import RexScalarSubquery as _RS
+        if isinstance(rex, _RS):
+            return _scan_uids(rex.plan)
+        if isinstance(rex, _RC):
+            return [u for o in rex.operands for u in _rex_scan_uids(o)]
+        return []
+
+    def _scan_uids(rel: RelNode) -> list:
+        if isinstance(rel, LogicalTableScan):
+            entry = context.schema.get(rel.schema_name)
+            tbl = (entry.tables[rel.table_name].table
+                   if entry is not None and rel.table_name in entry.tables
+                   else None)
+            return [str(getattr(tbl, "uid", "?"))]
+        out = [u for i in rel.inputs for u in _scan_uids(i)]
+        # scalar-subquery plans live in rex trees, not inputs — their scans
+        # must contribute uids too or the race this digest closes reopens
+        from ..plan.nodes import (LogicalFilter as _LF, LogicalJoin as _LJ,
+                                  LogicalProject as _LP)
+        if isinstance(rel, _LP):
+            for e in rel.exprs:
+                out.extend(_rex_scan_uids(e))
+        elif isinstance(rel, _LF):
+            out.extend(_rex_scan_uids(rel.condition))
+        elif isinstance(rel, _LJ) and rel.condition is not None:
+            out.extend(_rex_scan_uids(rel.condition))
+        return out
+
     digest = hashlib.blake2s(
         (node.explain() + "|"
-         + ",".join(f.stype.name for f in node.schema)).encode()
+         + ",".join(f.stype.name for f in node.schema) + "|"
+         + ",".join(_scan_uids(node))).encode()
     ).hexdigest()[:16]
     name = f"t{digest}"
     # pad to a power-of-2 capacity with row validity: the outer program is

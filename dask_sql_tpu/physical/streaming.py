@@ -714,6 +714,18 @@ def _stream_window_split(win: LogicalWindow, scan, path, source, context):
     if not selections:
         selections = [np.arange(0)]
     cap = max(len(s) for s in selections)
+    if cap > 2 * int(source.batch_rows):
+        # hash skew / one giant partition: the largest bucket (and the
+        # shared capacity every bucket pads to) exceeds the streaming batch
+        # size, weakening the out-of-core bound to ~cap resident rows.
+        # Correctness is unaffected (partitions must stay whole, so the
+        # bound genuinely cannot be tighter than the largest partition) —
+        # but it must never weaken SILENTLY (no-silent-caps policy).
+        logger.warning(
+            "streaming window: partition skew — largest bucket %d rows vs "
+            "batch_rows %d; device working set for the window step is "
+            "~%.1fx the configured bound", cap, int(source.batch_rows),
+            cap / max(int(source.batch_rows), 1))
 
     import jax.numpy as jnp
 

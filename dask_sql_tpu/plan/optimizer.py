@@ -993,12 +993,11 @@ def optimize(plan: RelNode, enable_pruning: bool = True) -> RelNode:
     fallback for plans carrying Python-only payloads (UDFs, custom
     aggregations, PREDICT nodes) and the semantics reference the native
     port is tested against (tests/unit/test_native_optimizer.py)."""
-    import os as _os
-    if _os.environ.get("DSQL_NATIVE", "1") != "0":
-        from .native_planner import optimize_native
-        native = optimize_native(plan, enable_pruning)
-        if native is not None:
-            return native
+    # the DSQL_NATIVE=0 opt-out lives in native.load() — one gate, not two
+    from .native_planner import optimize_native
+    native = optimize_native(plan, enable_pruning)
+    if native is not None:
+        return native
     for p in PASSES:
         plan = p(plan)
     plan = optimize_subplans(plan)

@@ -316,6 +316,27 @@ def test_window_without_partition_rejected(window_pair):
         ck.sql("SELECT k, SUM(v) OVER (ORDER BY v) AS c FROM t")
 
 
+def test_window_partition_skew_warns(caplog):
+    """One giant partition defeats the per-bucket memory bound; the result
+    stays correct but the weakened bound must be LOUD (no silent caps)."""
+    import logging
+
+    n = 600
+    df = pd.DataFrame({"k": np.zeros(n, dtype=np.int64),
+                       "v": np.arange(n, dtype=np.float64)})
+    plain = Context()
+    plain.create_table("t", df)
+    ck = Context()
+    ck.create_table("t", df, chunked=True, batch_rows=100)
+    q = ("SELECT k, SUM(v) OVER (PARTITION BY k ORDER BY v) AS c "
+         "FROM t ORDER BY c LIMIT 50")
+    with caplog.at_level(logging.WARNING,
+                         logger="dask_sql_tpu.physical.streaming"):
+        got = ck.sql(q, return_futures=False)
+    _assert_frames(plain.sql(q, return_futures=False), got)
+    assert any("partition skew" in r.message for r in caplog.records)
+
+
 def test_window_streaming_composes_with_mesh():
     from dask_sql_tpu.parallel.mesh import default_mesh
 
