@@ -172,17 +172,66 @@ def _stage_main():
     warm_t0 = time.perf_counter()
     last_warm_done = [0.0]
 
+    # expensive programs (many fused join/agg pipelines) compile through a
+    # shared remote helper that gets OOM-killed when several land at once
+    # (r4: the 6 join-heavy queries all wedged) — heavy plans take a
+    # 2-permit semaphore so at most two of them compile concurrently while
+    # light plans keep the full thread-pool width
+    heavy_sem = threading.Semaphore(
+        int(os.environ.get("BENCH_HEAVY_COMPILES", "2")))
+
+    def _is_heavy(q) -> bool:
+        try:
+            from dask_sql_tpu.physical.compiled import _heavy_count
+            from dask_sql_tpu.sql.parser import parse_sql
+            stmt = parse_sql(QUERIES[q])[0]
+            return _heavy_count(c._get_plan(stmt.query)) >= 4
+        except Exception:
+            return False
+
+    compile_started = set()
+
     def warm_one(q):
         # journal the START too: a query missing from the final artifact can
         # then be classified as in-flight-at-kill vs never-started
         emit({"warm_start": q})
         t0 = time.perf_counter()
-        c.sql(QUERIES[q], return_futures=False)
+        if _is_heavy(q):
+            with heavy_sem:
+                with lock:
+                    compile_started.add(q)
+                c.sql(QUERIES[q], return_futures=False)
+        else:
+            with lock:
+                compile_started.add(q)
+            c.sql(QUERIES[q], return_futures=False)
         dt = time.perf_counter() - t0
         with lock:
             compiled_ok.add(q)
             last_warm_done[0] = time.perf_counter() - warm_t0
         emit({"warm_q": q, "sec": round(dt, 3)})
+
+    def learn_split_hint(q):
+        """Persist the engine's "split this plan" hint for a query whose
+        whole-plan compile the remote helper silently lost — the NEXT
+        child (default config) then compiles it as small programs, while
+        queries that never got a compile attempt keep their standard
+        whole-plan configuration."""
+        try:
+            from dask_sql_tpu.ops.pallas_kernels import _strategy_on_tpu
+            from dask_sql_tpu.physical import compiled as _cm
+            from dask_sql_tpu.sql.parser import parse_sql
+
+            plan = c._get_plan(parse_sql(QUERIES[q])[0].query)
+            scans = []
+            key = (_cm._fp_plan(plan, c, scans), _cm._fp_inputs(scans),
+                   bool(_strategy_on_tpu()))
+            _cm._learned_caps_put(key, {**_cm._learned_caps_get(key),
+                                        "__split__": 1})
+            return True
+        except Exception as e:
+            emit({"hint_fail": q, "error": repr(e)[:200]})
+            return False
 
     t0 = warm_t0
     futs = {}
@@ -213,12 +262,19 @@ def _stage_main():
     # minimum per query.
     measured, failed = set(), set()
     warmup_sec = 0.0
+    # a compile request the remote helper silently dropped (OOM-killed
+    # server side) never raises AND never lands — without a wedge timeout
+    # one such query consumes the whole child budget and starves the
+    # retry children (this is exactly how r4 lost its 6 queries)
+    wedge_timeout = float(os.environ.get("BENCH_WEDGE_TIMEOUT", "420"))
+    last_progress = [time.perf_counter()]
     try:
         while left() > 15:
             for q, f in list(futs.items()):
                 if q not in failed and f.done() \
                         and f.exception() is not None:
                     failed.add(q)
+                    last_progress[0] = time.perf_counter()
                     emit({"warm_fail": q,
                           "error": repr(f.exception())[:300]})
             # sample the all-done flag BEFORE the ready snapshot: the last
@@ -228,10 +284,34 @@ def _stage_main():
             with lock:
                 ready = [q for q in qids
                          if q in compiled_ok and q not in measured]
+                if last_warm_done[0] + warm_t0 > last_progress[0]:
+                    last_progress[0] = last_warm_done[0] + warm_t0
             if not ready:
                 if len(measured) + len(failed) >= len(qids) or all_done:
                     break
                 if not futs:
+                    break
+                if time.perf_counter() - last_progress[0] > wedge_timeout:
+                    # declare wedged ONLY the stragglers whose compile
+                    # actually STARTED (queries queued behind the pool or
+                    # the heavy semaphore made no attempt and must not
+                    # inherit a failure): mark them, persist the engine's
+                    # split hint for each so the next child — running the
+                    # standard config — compiles THEM as small programs
+                    # and everything else whole, then move on to the
+                    # quiesced pass
+                    with lock:
+                        pending = [q for q, f in futs.items()
+                                   if not f.done() and q in compile_started
+                                   and q not in compiled_ok]
+                    for q in pending:
+                        failed.add(q)
+                        learn_split_hint(q)
+                        emit({"warm_fail": q,
+                              "error": f"wedged: no warmup progress in "
+                                       f"{wedge_timeout:.0f}s (remote "
+                                       f"compile presumed lost; split "
+                                       f"hint learned)"})
                     break
                 time.sleep(2)
                 continue
@@ -627,13 +707,24 @@ def main():
 
     attempt = 0
     max_attempts = int(os.environ.get("BENCH_MAX_CHILDREN", "3"))
+    # per-attempt DSQL_SPLIT_HEAVY schedule ("-" = engine default).  The
+    # primary splitting mechanism is the engine's learned per-plan hint
+    # (wedged/failed compiles persist "__split__" into the caps file, so
+    # retry children split exactly the guilty plans and nothing else);
+    # this env schedule is the LAST-RESORT hammer for a final child when
+    # hints could not be written.  Measured on the tunneled TPU (r5):
+    # Q3's whole program never returns from the remote helper, split=2
+    # SIGSEGVs it, split=1 compiles in ~290 s and runs.
+    split_schedule = os.environ.get("BENCH_SPLIT_SCHEDULE", "-,-,1").split(",")
     while attempt < max_attempts:
         got, failed = journal_state()
         # compile failures over the tunnel are often TRANSIENT (the remote
-        # helper gets OOM-killed under load): one retry in a fresh child;
-        # two strikes is a real verdict
+        # helper gets OOM-killed under load), and wedge-detected stragglers
+        # deserve a smaller-program retry — a strike earned at a higher
+        # split threshold must not bar the retry at a lower one, so a
+        # query stays retryable while its failure count <= attempt number
         remaining_q = [q for q in qids
-                       if q not in got and failed.get(q, 0) < 2]
+                       if q not in got and failed.get(q, 0) <= attempt]
         budget_left = deadline - EMIT_MARGIN - time.monotonic()
         if not remaining_q or budget_left < MIN_CHILD_BUDGET:
             break
@@ -641,6 +732,10 @@ def main():
         env = dict(env_base,
                    BENCH_STAGE_QUERIES=",".join(map(str, remaining_q)),
                    BENCH_CHILD_DEADLINE=str(child_deadline_ts))
+        split = (split_schedule[attempt] if attempt < len(split_schedule)
+                 else split_schedule[-1])
+        if split.strip() not in ("", "-"):
+            env["DSQL_SPLIT_HEAVY"] = split.strip()
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,

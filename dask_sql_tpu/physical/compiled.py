@@ -63,7 +63,7 @@ _CACHE_LIMIT = 128
 _DENY_OPS = {"RAND", "RAND_INTEGER"}
 
 stats = {"compiles": 0, "hits": 0, "fallbacks": 0, "unsupported": 0,
-         "recompiles": 0, "compile_errors": 0}
+         "recompiles": 0, "compile_errors": 0, "split_hints": 0}
 
 
 class Unsupported(Exception):
@@ -2100,7 +2100,8 @@ def _heavy_count(rel: RelNode) -> int:
     return n + sum(_heavy_count(i) for i in rel.inputs)
 
 
-def _split_point(plan: RelNode) -> Optional[RelNode]:
+def _split_point(plan: RelNode,
+                 limit_override: Optional[int] = None) -> Optional[RelNode]:
     """The subtree to peel into its own program: the node whose heavy-node
     count is closest to half the total (never the root, never a leaf)."""
     total = _heavy_count(plan)
@@ -2109,7 +2110,8 @@ def _split_point(plan: RelNode) -> Optional[RelNode]:
     # only the truly uncompilable plans split.  A lower threshold also
     # risks cutting at an edge that feeds a join as a duplicate-key build
     # (runtime fallback): TPC-H Q9 at threshold 5 does exactly that.
-    limit = int(os.environ.get("DSQL_SPLIT_HEAVY", "6"))
+    limit = (int(limit_override) if limit_override is not None
+             else int(os.environ.get("DSQL_SPLIT_HEAVY", "6")))
     if total <= limit:
         return None
     best, best_d = None, None
@@ -2142,11 +2144,15 @@ _split_lock = _threading.Lock()
 _split_refs: Dict[tuple, int] = {}
 
 
-def _execute_split(plan: RelNode, node: RelNode, context) -> Optional[Table]:
+def _execute_split(plan: RelNode, node: RelNode, context,
+                   split_limit: Optional[int] = None) -> Optional[Table]:
     from ..datacontainer import TableEntry
     from ..plan.nodes import Field, LogicalTableScan
 
-    sub = try_execute_compiled(node, context)  # may split again, recursively
+    # may split again, recursively — the SAME limit flows down so a learned
+    # "split this plan to 1" hint produces the same programs as an explicit
+    # DSQL_SPLIT_HEAVY=1 run (cache keys must line up between the two)
+    sub = try_execute_compiled(node, context, _split_limit=split_limit)
     if sub is None:
         return None  # subtree not compilable: let the caller's policy run
     # DETERMINISTIC temp name from the subtree's shape PLUS the scanned
@@ -2224,7 +2230,7 @@ def _execute_split(plan: RelNode, node: RelNode, context) -> Optional[Table]:
                 for i, f in enumerate(node.schema)])
     try:
         return try_execute_compiled(_replace_node(plan, node, scan),
-                                    context)
+                                    context, _split_limit=split_limit)
     finally:
         with _split_lock:
             _split_refs[ref_key] -= 1
@@ -2233,14 +2239,41 @@ def _execute_split(plan: RelNode, node: RelNode, context) -> Optional[Table]:
                 context.schema[_SPLIT_SCHEMA].tables.pop(name, None)
 
 
-def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
+def try_execute_compiled(plan: RelNode, context,
+                         _split_limit: Optional[int] = None
+                         ) -> Optional[Table]:
     """Execute via the compiled pipeline; None => caller should run eager."""
     if os.environ.get("DSQL_COMPILE", "1") == "0":
         return None
-    split_at = _split_point(plan)
-    if split_at is not None:
-        return _execute_split(plan, split_at, context)
     from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
+
+    # fingerprint ONCE: the same (plan_fp, input_fp, backend) tuple serves
+    # the split-hint lookup here and base_key below (recomputed only when
+    # host-sort peeling changes the plan, which never happens on TPU —
+    # the only backend hints are written for)
+    scans: list = []
+    try:
+        plan_fp = _fp_plan(plan, context, scans)
+    except Unsupported as e:
+        logger.debug("not compilable: %s", e)
+        stats["unsupported"] += 1
+        return None
+    base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()))
+
+    split_limit = _split_limit
+    if split_limit is None and _heavy_count(plan) > 1:
+        # learned split hint: a plan whose whole program crashed the
+        # remote TPU compiler (observed: helper SIGSEGV / silent loss on
+        # TPC-H Q3's fused sort-pipeline) carries "__split__" in its
+        # learned-caps entry, so every later process splits it immediately
+        # instead of re-crashing the compiler
+        hint = _learned_caps_get(base_key).get("__split__")
+        if hint is not None:
+            split_limit = int(hint)
+    split_at = _split_point(plan, split_limit)
+    if split_at is not None:
+        return _execute_split(plan, split_at, context,
+                              split_limit=split_limit)
     host_sort = None
     if not _on_tpu() and isinstance(plan, LogicalSort):
         # Terminal ORDER BY/LIMIT runs on the HOST off-TPU: the result is
@@ -2252,18 +2285,18 @@ def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
         # there and everything before the single fetch should fuse.
         host_sort = plan
         plan = plan.input
-    scans: list = []
-    try:
-        plan_fp = _fp_plan(plan, context, scans)
-    except Unsupported as e:
-        logger.debug("not compilable: %s", e)
-        stats["unsupported"] += 1
-        return None
-    # the backend joins the key: tracing picks backend-specific strategies
-    # (merge vs gather join), and with content-based input fingerprints a
-    # program — or an _UNSUPPORTED verdict — traced for one backend could
-    # otherwise replay on another
-    base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()))
+        scans = []
+        try:
+            plan_fp = _fp_plan(plan, context, scans)
+        except Unsupported as e:
+            logger.debug("not compilable: %s", e)
+            stats["unsupported"] += 1
+            return None
+        # the backend joins the key: tracing picks backend-specific
+        # strategies (merge vs gather join), and with content-based input
+        # fingerprints a program — or an _UNSUPPORTED verdict — traced for
+        # one backend could otherwise replay on another
+        base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()))
     # runtime verdicts (non-unique build keys, hash collisions) depend on
     # NUMERIC data the layout fingerprint cannot see, so they are pinned to
     # the exact Tables via uid — a reload with corrected data must get a
@@ -2273,6 +2306,9 @@ def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
         stats["fallbacks"] += 1
         return None
     caps: Dict[str, int] = _learned_caps_get(base_key)
+    # "__split__" is the learned split hint, not an aggregate-site cap: it
+    # must not leak into the program cache key or _build's cap lookups
+    caps.pop("__split__", None)
     for _ in range(8):  # capacity-escalation bound
         key = (base_key, tuple(sorted(caps.items())))
         entry = _cache.get(key)
@@ -2307,6 +2343,27 @@ def try_execute_compiled(plan: RelNode, context) -> Optional[Table]:
                 fails = _compile_failures.get(key, 0) + 1
                 _bounded_put(_compile_failures, key, fails)
                 if fails >= 2:
+                    if (_split_limit is None and _on_tpu()
+                            and _heavy_count(plan) > 1):
+                        # TWO consecutive whole-plan compile failures
+                        # (observed: remote helper SIGSEGV on fused
+                        # sort-pipelines) — one failure may be a transient
+                        # tunnel drop, two is a verdict on the program.
+                        # Learn a persistent "split to 1" hint for this
+                        # plan shape and retry immediately as small
+                        # programs; every later process reads the hint and
+                        # never re-crashes the compiler
+                        stats["split_hints"] += 1
+                        _learned_caps_put(base_key,
+                                          {**_learned_caps_get(base_key),
+                                           "__split__": 1})
+                        logger.warning(
+                            "whole-plan compile failed twice (%s); learned "
+                            "split hint, retrying as split programs",
+                            type(e).__name__)
+                        _compile_failures.pop(key, None)
+                        return try_execute_compiled(plan, context,
+                                                    _split_limit=1)
                     _cache[key] = _UNSUPPORTED
                     stats["unsupported"] += 1
                 else:

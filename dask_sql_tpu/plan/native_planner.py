@@ -269,8 +269,14 @@ def deserialize_plan(text: str) -> RelNode:
 def optimize_native(plan: RelNode,
                     enable_pruning: bool = True) -> Optional[RelNode]:
     """Run the native optimizer; None => caller falls back to Python."""
+    import os
+
     from .. import native as _native
 
+    # checked per CALL, not only at library load: load() memoizes, so its
+    # own DSQL_NATIVE check cannot honor a runtime toggle
+    if os.environ.get("DSQL_NATIVE", "1") == "0":
+        return None
     lib = _native.load()
     if lib is None or not hasattr(lib, "dsql_optimize"):
         return None

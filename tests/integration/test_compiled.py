@@ -456,6 +456,61 @@ def test_plan_splitting_matches_whole(monkeypatch):
             "split temps must be cleaned up"
 
 
+def test_learned_split_hint(monkeypatch, tmp_path):
+    """A persisted "__split__" caps hint makes the plan execute as split
+    programs (same answer), without env DSQL_SPLIT_HEAVY — the mechanism
+    that stops a plan whose whole program crashes the remote TPU compiler
+    from re-crashing it in every process."""
+    import pandas as pd
+
+    from benchmarks.tpch import QUERIES, generate_tpch
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.physical import compiled as cm
+
+    monkeypatch.setenv("DSQL_CAPS_FILE", str(tmp_path / "caps.json"))
+    monkeypatch.setattr(cm, "_caps_disk", None)
+    monkeypatch.setattr(cm, "_learned_caps", type(cm._learned_caps)())
+    data = generate_tpch(0.005)
+    c = Context()
+    for n, f in data.items():
+        c.create_table(n, f)
+
+    splits = []
+    orig = cm._execute_split
+
+    def spy(plan, node, context, split_limit=None):
+        splits.append(split_limit)
+        return orig(plan, node, context, split_limit)
+
+    monkeypatch.setattr(cm, "_execute_split", spy)
+
+    # no hint: Q3 (3 heavy nodes, default threshold 6) runs unsplit
+    got1 = c.sql(QUERIES[3], return_futures=False)
+    assert splits == []
+
+    # write the hint for this exact plan shape, as the failure path would
+    from dask_sql_tpu.sql.parser import parse_sql
+    plan = c._get_plan(parse_sql(QUERIES[3])[0].query)
+    from dask_sql_tpu.ops.pallas_kernels import _strategy_on_tpu
+    scans = []
+    key = (cm._fp_plan(plan, c, scans), cm._fp_inputs(scans),
+           bool(_strategy_on_tpu()))
+    cm._learned_caps_put(key, {"__split__": 1})
+
+    got2 = c.sql(QUERIES[3], return_futures=False)
+    assert splits and splits[0] == 1, "hint must force the split path"
+    pd.testing.assert_frame_equal(got1.reset_index(drop=True),
+                                  got2.reset_index(drop=True),
+                                  check_dtype=False, rtol=1e-5, atol=1e-8)
+
+    # a FRESH process state (cleared memo) still reads the hint from disk
+    monkeypatch.setattr(cm, "_caps_disk", None)
+    monkeypatch.setattr(cm, "_learned_caps", type(cm._learned_caps)())
+    splits.clear()
+    c.sql(QUERIES[3], return_futures=False)
+    assert splits and splits[0] == 1
+
+
 def test_filter_compaction_learned_caps(monkeypatch):
     """Learned-capacity compaction after selective filters (TPU strategy):
     the compiled result must match eager, engage only above the size
