@@ -30,6 +30,7 @@ import threading as _threading
 import logging
 import math
 import os
+import time
 import weakref
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
@@ -64,6 +65,10 @@ _DENY_OPS = {"RAND", "RAND_INTEGER"}
 
 stats = {"compiles": 0, "hits": 0, "fallbacks": 0, "unsupported": 0,
          "recompiles": 0, "compile_errors": 0, "split_hints": 0}
+
+# DSQL_TIME_DEVICE=1 diagnostic: per-call split of the execute wall into
+# dispatch+device-compute vs host materialize (see try_execute_compiled)
+last_exec_profile: Dict[str, float] = {}
 
 
 class Unsupported(Exception):
@@ -1078,8 +1083,9 @@ class _Tracer:
             col = src.table.columns[agg.args[0]] if agg.args else None
             if agg.op not in ("SUM", "$SUM0", "AVG", "COUNT") or agg.distinct:
                 return None
-            if col is not None and not jnp.issubdtype(col.data.dtype,
-                                                      jnp.floating):
+            if col is not None and col.stype.is_string:
+                return None
+            if col is not None and col.data.dtype == jnp.bool_:
                 return None
 
         n = src.n
@@ -1093,6 +1099,7 @@ class _Tracer:
         from ..types import exact_decimal_scale
 
         mxu_rows = [kmask.astype(jnp.float64)]  # row 0: occupancy counts
+        row_classes = ["unit"]  # per-row grid for the limb MXU kernel
         slots = []
         for j, agg in enumerate(rel.aggs):
             f = rel.schema[len(rel.group_keys) + j]
@@ -1109,6 +1116,7 @@ class _Tracer:
                 vmask = jnp.ones(n, bool) if fmask is None else fmask
                 vrow = vmask.astype(jnp.float64)
                 crow = vrow
+                rc = "unit"
             else:
                 vmask = col.valid_mask() if fmask is None \
                     else (col.valid_mask() & fmask)
@@ -1117,12 +1125,24 @@ class _Tracer:
                     data = jnp.round(data * factor)
                 vrow = jnp.where(vmask, data, 0.0)
                 crow = vmask.astype(jnp.float64)
+                is_int = factor != 1.0 or jnp.issubdtype(col.data.dtype,
+                                                         jnp.integer)
+                if is_int:
+                    # the int grid is bit-exact only below 2^53; decimal
+                    # scales are pre-gated (p<=15) but a raw BIGINT
+                    # column's magnitude is data-dependent
+                    self.fallback.append(
+                        jnp.max(jnp.abs(vrow)) >= 2.0 ** 53)
+                rc = "int" if is_int else "float"
             slots.append((j, agg, f, len(mxu_rows), factor))
             mxu_rows.append(vrow)
+            row_classes.append(rc)
             mxu_rows.append(crow)
+            row_classes.append("unit")
 
         stack = jnp.stack(mxu_rows)
-        red = pk.segmented_sums_dispatch(stack, codes, kmask, domain)
+        red = pk.segmented_sums_dispatch(stack, codes, kmask, domain,
+                                         row_classes=row_classes)
         occupancy = red[0] > 0
 
         from ..types import physical_dtype
@@ -1803,6 +1823,7 @@ _UNSUPPORTED = object()
 # fingerprint, input layout fingerprint, strategy — so a cap never applies
 # to a different query, data layout, or backend strategy.
 _caps_disk: Optional[Dict[str, Dict[str, int]]] = None
+_caps_seed: Optional[Dict[str, Dict[str, int]]] = None
 
 
 def _caps_disk_key(base_key) -> str:
@@ -1825,13 +1846,28 @@ def _learned_caps_get(base_key) -> Dict[str, int]:
     caps = _learned_caps.get(base_key)
     if caps is not None:
         return dict(caps)
+    key = None
     path = os.environ.get("DSQL_CAPS_FILE")
-    if not path:
-        return {}
-    global _caps_disk
-    if _caps_disk is None:
-        _caps_disk = _caps_disk_read(path)
-    return dict(_caps_disk.get(_caps_disk_key(base_key), {}))
+    if path:
+        global _caps_disk
+        if _caps_disk is None:
+            _caps_disk = _caps_disk_read(path)
+        key = _caps_disk_key(base_key)
+        hit = _caps_disk.get(key)
+        if hit:
+            return dict(hit)
+    # read-only seed (``DSQL_CAPS_SEED=/path.json``): caps and split hints
+    # learned on one host, committed with the repo, consulted when neither
+    # memory nor the writable caps file knows this program.  Keys are
+    # content-based (plan + input-layout fingerprints), so a seed entry can
+    # only ever match the same query over same-layout data — on any host.
+    seed_path = os.environ.get("DSQL_CAPS_SEED")
+    if seed_path:
+        global _caps_seed
+        if _caps_seed is None:
+            _caps_seed = _caps_disk_read(seed_path)
+        return dict(_caps_seed.get(key or _caps_disk_key(base_key), {}))
+    return {}
 
 
 def _learned_caps_put(base_key, caps: Dict[str, int]) -> None:
@@ -2384,9 +2420,24 @@ def try_execute_compiled(plan: RelNode, context,
         else:
             stats["hits"] += 1
             _cache.move_to_end(key)
-            outs = entry.fn(*flat)
+            if os.environ.get("DSQL_TIME_DEVICE"):
+                # diagnostic split of exec wall: dispatch+device compute
+                # (block_until_ready) vs host materialize/decode.  Costs one
+                # extra device sync per call, so opt-in only.
+                t0 = time.perf_counter()
+                outs = entry.fn(*flat)
+                jax.block_until_ready(outs)
+                t1 = time.perf_counter()
+                last_exec_profile["device_ms"] = (t1 - t0) * 1e3
+                last_exec_profile["materialize_t0"] = t1
+            else:
+                outs = entry.fn(*flat)
         try:
             result = _materialize(entry, outs)
+            _mt0 = last_exec_profile.pop("materialize_t0", None)
+            if _mt0 is not None:
+                last_exec_profile["materialize_ms"] = \
+                    (time.perf_counter() - _mt0) * 1e3
         except _NeedsRecompile as r:
             stats["recompiles"] += 1
             caps = r.caps

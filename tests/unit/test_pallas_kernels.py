@@ -88,3 +88,117 @@ def test_xla_blocked_nonfinite_safe_wrapper():
     got = np.asarray(pk._nonfinite_safe(pk.segmented_sums_xla_blocked)(
         vals, codes, mask, 3))
     assert np.isnan(got[0, 0]) and got[0, 1] == 3.0 and got[0, 2] == np.inf
+
+
+@pytest.mark.parametrize("n,g,a", [(100, 3, 1), (5000, 25, 3), (9000, 8, 2)])
+def test_segmented_sums_exact_matches_oracle_bitwise(n, g, a):
+    """The limb kernel's claim is EXACTNESS on integer-grid values (scaled
+    decimals / counts), including negatives and magnitudes near 2**52."""
+    rng = np.random.RandomState(11)
+    # integer grid up to ~1e9 per value plus a few +-2**50 outliers: total
+    # magnitude stays inside the kernel's sum(|v|) < 2**53 contract (the
+    # same bound the f64 scan path it replaces had)
+    vals = rng.randint(-10**9, 10**9, (a, n)).astype(np.float64)
+    vals[:, 0] = 2.0**50
+    vals[:, 1] = -(2.0**50)
+    vals[:, 2] = 2.0**50
+    vals = jnp.asarray(vals)
+    codes = jnp.asarray(rng.randint(0, g, n))
+    mask = jnp.asarray(rng.rand(n) > 0.3)
+    got = np.asarray(pk.segmented_sums_exact(vals, codes, mask, g,
+                                             interpret=True))
+    # numpy int64 accumulation is the exact oracle
+    vn = np.asarray(vals).astype(np.int64)
+    cn, mn = np.asarray(codes), np.asarray(mask)
+    want = np.zeros((a, g), dtype=np.int64)
+    for gg in range(g):
+        want[:, gg] = vn[:, mn & (cn == gg)].sum(axis=1)
+    assert np.array_equal(got, want.astype(np.float64)), (
+        np.abs(got - want).max())
+
+
+def test_segmented_sums_exact_nonfinite_masked_rows_ignored():
+    vals = jnp.asarray([[1.0, np.nan, 3.0, np.inf, 5.0]])
+    codes = jnp.asarray([0, 0, 1, 1, 1])
+    mask = jnp.asarray([True, False, True, False, True])
+    got = np.asarray(pk.segmented_sums_exact(vals, codes, mask, 2,
+                                             interpret=True))
+    assert np.array_equal(got, np.asarray([[1.0, 8.0]]))
+
+
+def test_segmented_sums_exact_nonfinite_poison_confined():
+    vals = jnp.asarray([[1.0, np.inf, 2.0, 4.0]])
+    codes = jnp.asarray([0, 0, 1, 1])
+    mask = jnp.ones(4, dtype=bool)
+    got = np.asarray(pk.segmented_sums_exact(vals, codes, mask, 2,
+                                             interpret=True))
+    assert np.isposinf(got[0, 0]) and got[0, 1] == 6.0
+
+
+def test_dispatch_mixed_classes_matches_oracle(monkeypatch):
+    """Mixed int/float/unit stacks ride one limb kernel call; int rows stay
+    bit-exact, float rows land within sub-ulp of the f64 oracle."""
+    monkeypatch.setenv("DSQL_PALLAS", "force")
+    rng = np.random.RandomState(3)
+    n, g = 2048, 6
+    vals = jnp.asarray(np.vstack([
+        np.round(rng.randint(-10**9, 10**9, n)).astype(np.float64),
+        rng.randn(n),
+        (rng.rand(n) > 0.5).astype(np.float64),
+    ]))
+    codes = jnp.asarray(rng.randint(0, g, n))
+    mask = jnp.asarray(rng.rand(n) > 0.2)
+    got = np.asarray(pk.segmented_sums_dispatch(
+        vals, codes, mask, g, row_classes=["int", "float", "unit"]))
+    want = np.asarray(pk.reference_segmented_sums(vals, codes, mask, g))
+    assert np.array_equal(got[0], want[0])      # int row: bit-exact
+    assert np.array_equal(got[2], want[2])      # unit row: bit-exact
+    np.testing.assert_allclose(got[1], want[1], rtol=1e-12)
+
+
+def test_fixedpoint_float_rows_beat_f64_accumulation():
+    """Float rows: the fixed-point sum is within one ulp-of-max of the
+    TRUE sum (np.float128 oracle) across 12 orders of magnitude — tighter
+    than f64 accumulation, which the old scan path could only match."""
+    rng = np.random.RandomState(7)
+    n, g = 20000, 4
+    vals = (rng.randn(2, n) * 10.0 ** rng.randint(-6, 7, (2, n))
+            ).astype(np.float64)
+    codes = rng.randint(0, g, n)
+    mask = rng.rand(n) > 0.1
+    got = np.asarray(pk.segmented_sums_fixedpoint(
+        jnp.asarray(vals), jnp.asarray(codes), jnp.asarray(mask), g,
+        row_classes=["float", "float"], interpret=True))
+    for i in range(2):
+        for gg in range(g):
+            sel = mask & (codes == gg)
+            want = vals[i, sel].astype(np.float128).sum()
+            # ~1 ulp of the sum (compensated recombination) + the grid
+            # truncation bound n * max|v| * 2**-81
+            tol = (2.0 * abs(float(want)) * 2.0 ** -52
+                   + sel.sum() * np.abs(vals[i, sel]).max(initial=0.0)
+                   * 2.0 ** -81)
+            assert abs(float(want) - got[i, gg]) <= max(tol, 1e-300), (
+                i, gg, float(want), got[i, gg])
+
+
+def test_fixedpoint_tiny_and_huge_magnitudes():
+    """Runtime power-of-two normalization handles extreme row scales."""
+    for m in (1e-200, 1.0, 1e200):
+        vals = jnp.asarray([[m, 2 * m, -m, 3 * m]])
+        codes = jnp.asarray([0, 0, 1, 1])
+        mask = jnp.ones(4, bool)
+        got = np.asarray(pk.segmented_sums_fixedpoint(
+            vals, codes, mask, 2, row_classes=["float"], interpret=True))
+        np.testing.assert_allclose(got, [[3 * m, 2 * m]], rtol=1e-12)
+
+
+def test_fixedpoint_zero_row_and_empty_input():
+    got = np.asarray(pk.segmented_sums_fixedpoint(
+        jnp.zeros((2, 5)), jnp.zeros(5, jnp.int32), jnp.ones(5, bool), 3,
+        row_classes=["float", "int"], interpret=True))
+    assert np.array_equal(got, np.zeros((2, 3)))
+    got = np.asarray(pk.segmented_sums_fixedpoint(
+        jnp.zeros((2, 0)), jnp.zeros(0, jnp.int32), jnp.ones(0, bool), 3,
+        row_classes=["float", "int"], interpret=True))
+    assert np.array_equal(got, np.zeros((2, 3)))
