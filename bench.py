@@ -3,6 +3,12 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
 
+The line is printed twice — once bare (legacy parsers) and once behind the
+``DSQL_BENCH_RESULT `` sentinel prefix on its own line — and written to
+``bench_result.json`` in the work dir (override: ``BENCH_RESULTS_FILE``):
+interleaved ANSI/log output mangled the bare line in r05 ("parsed": null),
+and a sentinel + file artifact survive any amount of log noise.
+
 The workload is the BASELINE.md primary metric: the Q1-Q22 geomean wall-clock
 over generated TPC-H data, end-to-end through Context.sql (SQL text to host
 pandas frame).  ``vs_baseline`` is the geomean speedup against single-threaded
@@ -577,7 +583,27 @@ def main():
                     "elapsed_sec": round(time.monotonic() - t_start, 1),
                 },
             }
-        print(json.dumps(out), flush=True)
+        line = json.dumps(out)
+        # results FILE first: it survives even a truncated stdout.  The
+        # write is atomic (tmp + replace) so a kill mid-emit can't leave a
+        # half-written artifact.
+        results_path = os.environ.get("BENCH_RESULTS_FILE")
+        if not results_path and state["progress"]:
+            results_path = os.path.join(
+                os.path.dirname(state["progress"]), "bench_result.json")
+        if results_path:
+            try:
+                tmp = f"{results_path}.tmp{os.getpid()}"
+                with open(tmp, "w") as f:
+                    f.write(line + "\n")
+                os.replace(tmp, results_path)
+            except OSError:
+                pass
+        # leading newline forces the bare line out of any partial log line;
+        # the sentinel copy is immune to interleaved ANSI/log output
+        sys.stdout.flush()
+        print("\n" + line, flush=True)
+        print("DSQL_BENCH_RESULT " + line, flush=True)
 
     def _die(signum, frame):
         _kill_child()

@@ -197,7 +197,10 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        pass  # jax < 0.5: the XLA_FLAGS fallback above covers it
 
     import pandas as pd
 

@@ -29,11 +29,32 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+
+def _x64_scope(enabled: bool):
+    """Context manager toggling x64 tracing: ``jax.enable_x64`` where it
+    exists, the ``jax.experimental`` spelling on older jax (0.4.x)."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(enabled)
+    from jax.experimental import enable_x64 as _e
+    return _e(enabled)
+
 BLOCK = 1024       # rows per grid step (lane-aligned multiple of 128)
 GROUP_TILE = 128   # group-axis padding (last-dim tile width)
 
 
 def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _backend_is_tpu() -> bool:
+    """The UNPATCHED hardware truth, gating pallas ``interpret=`` only:
+    tests monkeypatch ``_on_tpu`` to force kernel strategies on CPU, but a
+    non-interpret ``pallas_call`` on a non-TPU backend is a hard error
+    (jax 0.4.x: "Only interpret mode is supported on CPU backend") — the
+    interpret decision must never be fooled by a strategy override."""
     try:
         return jax.default_backend() == "tpu"
     except Exception:
@@ -213,7 +234,7 @@ def _segmented_sums_limbs(vals: jax.Array, codes: jax.Array,
         # in 32-bit scope (interpret mode keeps the caller's setting)
         import contextlib
         scope = (contextlib.nullcontext() if interpret
-                 else jax.enable_x64(False))
+                 else _x64_scope(False))
         with scope:
             per = pl.pallas_call(
                 _seg_matmul_perblock_kernel,
@@ -257,7 +278,7 @@ def segmented_sums_fixedpoint(vals: jax.Array, codes: jax.Array,
     (class 'unit' — 0/1 by construction) are summed alongside, then IEEE
     semantics reassembled."""
     if interpret is None:
-        interpret = not _on_tpu()
+        interpret = not _backend_is_tpu()
     a = vals.shape[0]
     cls = ["float"] * a if row_classes is None else list(row_classes)
 
@@ -293,7 +314,7 @@ def segmented_sums(vals: jax.Array, codes: jax.Array, mask: jax.Array,
     rows, and reconstitutes IEEE semantics afterwards.
     """
     if interpret is None:
-        interpret = not _on_tpu()
+        interpret = not _backend_is_tpu()
     return _nonfinite_safe(
         lambda v, c, m, g: _segmented_sums_finite(v, c, m, g, interpret)
     )(vals, codes, mask, num_groups)
@@ -319,7 +340,7 @@ def _segmented_sums_finite(vals: jax.Array, codes: jax.Array, mask: jax.Array,
     # 32-bit scope would silently canonicalize its f64 output to f32.
     import contextlib
     scope = (contextlib.nullcontext() if interpret
-             else jax.enable_x64(False))
+             else _x64_scope(False))
     with scope:
         out = pl.pallas_call(
             _seg_matmul_kernel,
@@ -398,9 +419,10 @@ def segmented_sums_dispatch(vals: jax.Array, codes: jax.Array,
     if forced or (_on_tpu() and vals.dtype != jnp.float32):
         return segmented_sums_fixedpoint(
             vals, codes, mask, num_groups, row_classes=row_classes,
-            interpret=not _on_tpu())
+            interpret=not _backend_is_tpu())
     if _on_tpu():
-        return segmented_sums(vals, codes, mask, num_groups, interpret=False)
+        return segmented_sums(vals, codes, mask, num_groups,
+                              interpret=not _backend_is_tpu())
     return reference_segmented_sums(vals, codes, mask, num_groups)
 
 

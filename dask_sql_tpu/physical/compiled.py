@@ -1,16 +1,32 @@
-"""Compiled query pipelines: whole-plan jit with static shapes.
+"""Compiled query pipelines: stage-graph jit with static shapes.
 
 The eager executor (physical/rel/executor.py) dispatches one XLA op at a
 time; over a remote TPU every dispatch is a host round trip and every
 data-dependent shape (boolean compaction, ``jnp.unique``) is a blocking sync.
 This module is the TPU-first answer (SURVEY §7 "hard parts" item 2): a query
-plan is traced ONCE into a single jitted program with *static shapes* —
-filters keep rows and flip a validity mask instead of compacting, GROUP BY
-factorizes via an in-trace lexsort with a static group-capacity bound, and
-equi-joins probe a sorted build side via ``searchsorted`` — then the program
-is cached keyed by (plan fingerprint, input shapes/dtypes + string-dictionary
-content). Steady state is ONE device dispatch + one tiny flags transfer per
-query, and reloading fresh data with the same layout never recompiles.
+plan is traced into jitted programs with *static shapes* — filters keep rows
+and flip a validity mask instead of compacting, GROUP BY factorizes via an
+in-trace lexsort with a static group-capacity bound, and equi-joins probe a
+sorted build side via ``searchsorted`` — each program cached keyed by (plan
+fingerprint, input shapes/dtypes + string-dictionary content). Steady state
+is one device dispatch + one tiny flags transfer per program, and reloading
+fresh data with the same layout never recompiles.
+
+**Stage graphs bound program size.** XLA:TPU compile time grows
+superlinearly with the number of fused heavy (join/aggregate/window)
+pipelines in one program (~50 s at 2, never-finishes at 8-9 over the
+tunneled TPU), so plans above a heavy-node budget are partitioned
+(physical/stages.py) into a DAG of stages of at most ``DSQL_STAGE_HEAVY``
+heavy nodes (default 6; legacy ``DSQL_SPLIT_HEAVY`` honored).  Stage
+outputs materialize into padded power-of-2 capacity-class temp tables
+(``__split__`` schema), keeping consumer program keys stable across runs.
+Because stages keep the ordinary content-addressed cache key, structurally
+shared pipelines across queries — TPC-H's repeated lineitem/orders
+scan→filter→join prefixes — compile once and hit from then on
+(``stats["cross_query_hits"]``); independent stages compile concurrently in
+a small worker pool (``DSQL_COMPILE_WORKERS``, default 4 — XLA compilation
+releases the GIL), turning a serial warmup wall into overlapped small
+compiles.
 
 Runtime conditions XLA cannot express statically (group-count overflow,
 non-unique build side, 64-bit hash collision) surface through a flags vector;
@@ -50,6 +66,8 @@ from ..plan.nodes import (
 )
 from ..table import dict_sort_order, Column, Scalar, Table
 from .rex.evaluate import evaluate_predicate, evaluate_rex
+from .stages import (StageGraph, heavy_count as _heavy_count,
+                     partition as _partition, stage_budget)
 
 logger = logging.getLogger(__name__)
 
@@ -64,7 +82,13 @@ _CACHE_LIMIT = 128
 _DENY_OPS = {"RAND", "RAND_INTEGER"}
 
 stats = {"compiles": 0, "hits": 0, "fallbacks": 0, "unsupported": 0,
-         "recompiles": 0, "compile_errors": 0, "split_hints": 0}
+         "recompiles": 0, "compile_errors": 0, "exiled": 0, "split_hints": 0,
+         # stage-graph observability: plans partitioned, stage programs
+         # compiled/served from cache, and cache hits arriving from a
+         # DIFFERENT query than the one that compiled the program (the
+         # cross-query reuse the stage design exists to create)
+         "stage_graphs": 0, "stage_compiles": 0, "stage_hits": 0,
+         "cross_query_hits": 0}
 
 # DSQL_TIME_DEVICE=1 diagnostic: per-call split of the execute wall into
 # dispatch+device-compute vs host materialize (see try_execute_compiled)
@@ -1795,14 +1819,15 @@ class _Tracer:
 # ---------------------------------------------------------------------------
 
 class _Compiled:
-    __slots__ = ("fn", "spec", "meta", "caps", "key")
+    __slots__ = ("fn", "spec", "meta", "caps", "key", "origin")
 
-    def __init__(self, fn, spec, meta, caps, key):
+    def __init__(self, fn, spec, meta, caps, key, origin=None):
         self.fn = fn
         self.spec = spec
         self.meta = meta        # filled during first trace
         self.caps = caps
         self.key = key
+        self.origin = origin    # root-query fingerprint that compiled it
 
 
 _cache: "OrderedDict[tuple, object]" = OrderedDict()
@@ -1911,7 +1936,8 @@ def _flatten_tables(scans) -> List[jax.Array]:
     return flat
 
 
-def _build(plan: RelNode, context, scans, caps: Dict[str, int], key):
+def _build(plan: RelNode, context, scans, caps: Dict[str, int], key,
+           origin=None):
     """Create the jitted program for this plan + input spec."""
     spec = []
     for skey, tbl, row_valid in scans:
@@ -1967,7 +1993,7 @@ def _build(plan: RelNode, context, scans, caps: Dict[str, int], key):
             outs.append(out.valid)
         return tuple(outs)
 
-    return _Compiled(jax.jit(fn), spec, meta, dict(caps), key)
+    return _Compiled(jax.jit(fn), spec, meta, dict(caps), key, origin)
 
 
 class _NeedsRecompile(Exception):
@@ -2101,192 +2127,248 @@ def _materialize(entry: _Compiled, outs) -> Table:
 
 
 # ---------------------------------------------------------------------------
-# whole-plan splitting: XLA:TPU compile time grows superlinearly with the
+# stage-graph execution: XLA:TPU compile time grows superlinearly with the
 # number of fused join/aggregate pipelines in one program — TPC-H Q2 (9
 # heavy nodes after decorrelation) never finished compiling over the
 # tunneled TPU (>27 min observed), while 2-join programs compile in tens of
-# seconds.  Above DSQL_SPLIT_HEAVY heavy nodes the plan executes as TWO
-# compiled programs with the subtree result materialized to a resident temp
-# between them (one extra ~100 ms device round trip; both halves hit the
-# program cache independently).
+# seconds.  Plans above the heavy-node budget (physical/stages.py,
+# DSQL_STAGE_HEAVY / legacy DSQL_SPLIT_HEAVY) are partitioned into a DAG of
+# bounded stages; every stage is traced and jitted as its own program with
+# the stage output materialized into a padded power-of-2 capacity-class
+# temp table (so the consumer's program key is stable across runs).  Stages
+# keep the ordinary (plan fingerprint, input layout) program-cache key:
+# structurally shared pipelines across queries — TPC-H's repeated
+# lineitem/orders scan→filter→join prefixes — compile once and hit from
+# then on (stats["cross_query_hits"]).  Independent stages execute
+# concurrently in a small worker pool: XLA compilation releases the GIL, so
+# a cold warmup becomes overlapped small compiles instead of one serial
+# monolith.
 # ---------------------------------------------------------------------------
 
 _SPLIT_SCHEMA = "__split__"
 
-
-def _heavy_count(rel: RelNode) -> int:
-    if isinstance(rel, LogicalJoin):
-        # SEMI/ANTI with a non-equi residual lower through the payload
-        # exist-test formulation whose compile cost dwarfs a plain
-        # equi-join — TPC-H Q21 (two of them + two joins) SIGKILLs the
-        # remote TPU compile helper as one program.  Plain equi SEMI/ANTI
-        # (Q4/Q20) compile like ordinary joins and keep weight 1.  The
-        # residual test is the SAME decomposition the lowering uses
-        # (_extract_equi_keys), so heuristic and lowering cannot drift.
-        from .rel.executor import _extract_equi_keys
-        n = 1
-        if rel.join_type in ("SEMI", "ANTI") and rel.condition is not None:
-            _, residual = _extract_equi_keys(rel)
-            if residual:
-                n = 2
-    elif isinstance(rel, (LogicalAggregate, LogicalWindow)):
-        n = 1
-    else:
-        n = 0
-    return n + sum(_heavy_count(i) for i in rel.inputs)
-
-
-def _split_point(plan: RelNode,
-                 limit_override: Optional[int] = None) -> Optional[RelNode]:
-    """The subtree to peel into its own program: the node whose heavy-node
-    count is closest to half the total (never the root, never a leaf)."""
-    total = _heavy_count(plan)
-    # observed on the tunneled TPU: ~50 s compile at 2 heavy nodes, ~400 s
-    # at 6 (tractable, and cached thereafter), never-finishes at 8-9 — so
-    # only the truly uncompilable plans split.  A lower threshold also
-    # risks cutting at an edge that feeds a join as a duplicate-key build
-    # (runtime fallback): TPC-H Q9 at threshold 5 does exactly that.
-    limit = (int(limit_override) if limit_override is not None
-             else int(os.environ.get("DSQL_SPLIT_HEAVY", "6")))
-    if total <= limit:
-        return None
-    best, best_d = None, None
-
-    def walk(rel: RelNode, is_root: bool):
-        nonlocal best, best_d
-        if not is_root:
-            h = _heavy_count(rel)
-            if 2 <= h <= total - 1:
-                d = abs(h - total / 2)
-                if best_d is None or d < best_d:
-                    best, best_d = rel, d
-        for i in rel.inputs:
-            walk(i, False)
-
-    walk(plan, True)
-    return best
-
-
-def _replace_node(plan: RelNode, old: RelNode, new: RelNode) -> RelNode:
-    if plan is old:
-        return new
-    if not plan.inputs:
-        return plan
-    return plan.with_inputs([_replace_node(i, old, new)
-                             for i in plan.inputs])
-
-
 _split_lock = _threading.Lock()
 _split_refs: Dict[tuple, int] = {}
+_state_lock = _threading.RLock()          # program cache + learned state
+_inflight: Dict[tuple, object] = {}       # key -> Event: dedupe concurrent compiles
 
 
-def _execute_split(plan: RelNode, node: RelNode, context,
-                   split_limit: Optional[int] = None) -> Optional[Table]:
-    from ..datacontainer import TableEntry
-    from ..plan.nodes import Field, LogicalTableScan
+def _rex_scan_uids(rex, context) -> list:
+    from ..plan.nodes import RexCall as _RC
+    from ..plan.nodes import RexScalarSubquery as _RS
+    if isinstance(rex, _RS):
+        return _scan_uids(rex.plan, context)
+    if isinstance(rex, _RC):
+        return [u for o in rex.operands for u in _rex_scan_uids(o, context)]
+    return []
 
-    # may split again, recursively — the SAME limit flows down so a learned
-    # "split this plan to 1" hint produces the same programs as an explicit
-    # DSQL_SPLIT_HEAVY=1 run (cache keys must line up between the two)
-    sub = try_execute_compiled(node, context, _split_limit=split_limit)
-    if sub is None:
-        return None  # subtree not compilable: let the caller's policy run
-    # DETERMINISTIC temp name from the subtree's shape PLUS the scanned
-    # tables' uids: the name feeds the OUTER program's plan fingerprint, so
-    # a per-execution counter would recompile the outer half on every run
-    # (and leak dead cache entries) — but shape alone is not enough, since
-    # catalog data can mutate (INSERT / re-register) between two concurrent
-    # executions sharing a context.  With uids folded in, identical digests
-    # imply identical subplans over identical table OBJECTS, so a
-    # concurrent overwrite writes equal content and is harmless.
-    def _rex_scan_uids(rex) -> list:
-        from ..plan.nodes import RexCall as _RC
-        from ..plan.nodes import RexScalarSubquery as _RS
-        if isinstance(rex, _RS):
-            return _scan_uids(rex.plan)
-        if isinstance(rex, _RC):
-            return [u for o in rex.operands for u in _rex_scan_uids(o)]
-        return []
 
-    def _scan_uids(rel: RelNode) -> list:
-        if isinstance(rel, LogicalTableScan):
-            entry = context.schema.get(rel.schema_name)
-            tbl = (entry.tables[rel.table_name].table
-                   if entry is not None and rel.table_name in entry.tables
-                   else None)
-            return [str(getattr(tbl, "uid", "?"))]
-        out = [u for i in rel.inputs for u in _scan_uids(i)]
-        # scalar-subquery plans live in rex trees, not inputs — their scans
-        # must contribute uids too or the race this digest closes reopens
-        from ..plan.nodes import (LogicalFilter as _LF, LogicalJoin as _LJ,
-                                  LogicalProject as _LP)
-        if isinstance(rel, _LP):
-            for e in rel.exprs:
-                out.extend(_rex_scan_uids(e))
-        elif isinstance(rel, _LF):
-            out.extend(_rex_scan_uids(rel.condition))
-        elif isinstance(rel, _LJ) and rel.condition is not None:
-            out.extend(_rex_scan_uids(rel.condition))
-        return out
+def _scan_uids(rel: RelNode, context) -> list:
+    """uids of every table a subtree scans (scalar-subquery plans included:
+    they live in rex trees, not inputs, and their scans must contribute or
+    the data-mutation race the stage digest closes reopens)."""
+    if isinstance(rel, LogicalTableScan):
+        if rel.schema_name == _SPLIT_SCHEMA:
+            # a boundary scan's NAME is already a content digest of its
+            # producing subtree (scan uids folded in transitively) — and the
+            # temp table may not be registered yet at partition time
+            return [rel.table_name]
+        entry = context.schema.get(rel.schema_name)
+        tbl = (entry.tables[rel.table_name].table
+               if entry is not None and rel.table_name in entry.tables
+               else None)
+        return [str(getattr(tbl, "uid", "?"))]
+    out = [u for i in rel.inputs for u in _scan_uids(i, context)]
+    from ..plan.nodes import (LogicalFilter as _LF, LogicalJoin as _LJ,
+                              LogicalProject as _LP)
+    if isinstance(rel, _LP):
+        for e in rel.exprs:
+            out.extend(_rex_scan_uids(e, context))
+    elif isinstance(rel, _LF):
+        out.extend(_rex_scan_uids(rel.condition, context))
+    elif isinstance(rel, _LJ) and rel.condition is not None:
+        out.extend(_rex_scan_uids(rel.condition, context))
+    return out
 
+
+def _stage_table_name(node: RelNode, context) -> str:
+    """DETERMINISTIC temp-table name from the subtree's shape PLUS the
+    scanned tables' uids: the name feeds the CONSUMER program's plan
+    fingerprint, so a per-execution counter would recompile the consumer on
+    every run (and leak dead cache entries) — but shape alone is not
+    enough, since catalog data can mutate (INSERT / re-register) between
+    two concurrent executions sharing a context.  With uids folded in,
+    identical digests imply identical subplans over identical table
+    OBJECTS, so a concurrent overwrite writes equal content and is
+    harmless.  Across queries the digest is what makes shared subplans
+    collide into ONE boundary name — the consumer-side half of cross-query
+    stage reuse."""
     digest = hashlib.blake2s(
         (node.explain() + "|"
          + ",".join(f.stype.name for f in node.schema) + "|"
-         + ",".join(_scan_uids(node))).encode()
+         + ",".join(_scan_uids(node, context))).encode()
     ).hexdigest()[:16]
-    name = f"t{digest}"
-    # pad to a power-of-2 capacity with row validity: the outer program is
-    # keyed on input SHAPES, and the subtree's true row count is data-
-    # dependent — capacity classes keep the key stable across runs
-    n = sub.num_rows
+    return f"t{digest}"
+
+
+def _make_boundary_scan(node: RelNode, context) -> LogicalTableScan:
+    from ..plan.nodes import Field
+    return LogicalTableScan(
+        schema_name=_SPLIT_SCHEMA,
+        table_name=_stage_table_name(node, context),
+        schema=[Field(f"c{i}", f.stype)
+                for i, f in enumerate(node.schema)])
+
+
+def _partition_plan(plan: RelNode, budget: int, context) -> StageGraph:
+    return _partition(plan, budget,
+                      lambda sub: _make_boundary_scan(sub, context))
+
+
+def _pad_capacity(table: Table):
+    """(padded table, row_valid): pad to a power-of-2 capacity with row
+    validity.  Consumer programs are keyed on input SHAPES and a stage's
+    true row count is data-dependent — capacity classes keep the key stable
+    across runs, so reloading fresh data through the same stage never
+    recompiles the consumer."""
+    n = table.num_rows
     cap = 1 << max((max(n, 1) - 1).bit_length(), 6)
-    sub = sub.with_names([f"c{i}" for i in range(sub.num_columns)])
+    table = table.with_names([f"c{i}" for i in range(table.num_columns)])
     if cap != n:
         pad = cap - n
         pcols = []
-        for c in sub.columns:
+        for c in table.columns:
             data = jnp.concatenate(
                 [c.data, jnp.zeros((pad,) + c.data.shape[1:],
                                    dtype=c.data.dtype)])
             mask = (None if c.mask is None else
                     jnp.concatenate([c.mask, jnp.zeros(pad, dtype=bool)]))
             pcols.append(Column(data, c.stype, mask, c.dictionary))
-        sub = Table(list(sub.names), pcols)
-    row_valid = jnp.arange(cap) < n
+        table = Table(list(table.names), pcols)
+    return table, jnp.arange(cap) < n
+
+
+def _register_stage_table(context, name: str, table: Table) -> None:
+    """Publish a stage output under __split__ (refcounted: concurrent
+    queries on one context may share a boundary name; the digest guarantees
+    equal content, so the overwrite is harmless)."""
+    from ..datacontainer import TableEntry
+    padded, row_valid = _pad_capacity(table)
     ref_key = (id(context), name)
     with _split_lock:
         if _SPLIT_SCHEMA not in context.schema:
             context.create_schema(_SPLIT_SCHEMA)
         context.schema[_SPLIT_SCHEMA].tables[name] = TableEntry(
-            table=sub, row_valid=row_valid)
+            table=padded, row_valid=row_valid)
         _split_refs[ref_key] = _split_refs.get(ref_key, 0) + 1
-    scan = LogicalTableScan(
-        schema_name=_SPLIT_SCHEMA, table_name=name,
-        schema=[Field(f"c{i}", f.stype)
-                for i, f in enumerate(node.schema)])
+
+
+def _unregister_stage_table(context, name: str) -> None:
+    ref_key = (id(context), name)
+    with _split_lock:
+        refs = _split_refs.get(ref_key, 0) - 1
+        if refs > 0:
+            _split_refs[ref_key] = refs
+            return
+        _split_refs.pop(ref_key, None)
+        sch = context.schema.get(_SPLIT_SCHEMA)
+        if sch is not None:
+            sch.tables.pop(name, None)
+
+
+def _compile_workers(n_stages: int) -> int:
     try:
-        return try_execute_compiled(_replace_node(plan, node, scan),
-                                    context, _split_limit=split_limit)
+        w = int(os.environ.get("DSQL_COMPILE_WORKERS", "4"))
+    except ValueError:
+        w = 4
+    return max(1, min(w, n_stages))
+
+
+def _execute_stage_graph(graph: StageGraph, context, query_fp: str,
+                         split_limit: Optional[int]) -> Optional[Table]:
+    """Run a stage DAG: dependencies first, independent stages concurrently.
+
+    Any stage that cannot run compiled (unsupported shape, runtime-flag
+    fallback) fails the whole graph to the eager executor — partial staged
+    execution would still pay the materialization round trips without the
+    single-dispatch payoff.  Temp tables are unregistered on EVERY path,
+    exceptions included.
+    """
+    stats["stage_graphs"] += 1
+    stages = graph.stages
+    nst = len(stages)
+    root_idx = nst - 1
+    registered: List[str] = []
+
+    def run_stage(idx: int) -> Optional[Table]:
+        return _execute_single(stages[idx].plan, context, query_fp,
+                               split_limit, in_stage=True)
+
+    try:
+        workers = _compile_workers(nst)
+        if workers == 1:
+            # serial: the list is already topological
+            for idx, st in enumerate(stages):
+                out = run_stage(idx)
+                if out is None:
+                    return None
+                if idx == root_idx:
+                    return out
+                _register_stage_table(context, st.scan.table_name, out)
+                registered.append(st.scan.table_name)
+            return None  # unreachable: the root returns above
+
+        from concurrent.futures import (FIRST_COMPLETED, ThreadPoolExecutor,
+                                        wait as _fwait)
+        pending = set(range(nst))
+        done: set = set()
+        futs: Dict[object, int] = {}
+        failed = False
+        result: Optional[Table] = None
+        with ThreadPoolExecutor(workers) as pool:
+            while (pending or futs) and not failed:
+                for i in sorted(pending):
+                    if all(d in done for d in stages[i].deps):
+                        pending.discard(i)
+                        futs[pool.submit(run_stage, i)] = i
+                if not futs:
+                    break
+                finished, _ = _fwait(list(futs),
+                                     return_when=FIRST_COMPLETED)
+                for f in finished:
+                    i = futs.pop(f)
+                    out = f.result()
+                    if out is None:
+                        failed = True
+                        continue
+                    if i == root_idx:
+                        result = out
+                    else:
+                        _register_stage_table(
+                            context, stages[i].scan.table_name, out)
+                        registered.append(stages[i].scan.table_name)
+                    done.add(i)
+        return None if failed else result
     finally:
-        with _split_lock:
-            _split_refs[ref_key] -= 1
-            if _split_refs[ref_key] <= 0:
-                _split_refs.pop(ref_key, None)
-                context.schema[_SPLIT_SCHEMA].tables.pop(name, None)
+        for name in registered:
+            _unregister_stage_table(context, name)
 
 
 def try_execute_compiled(plan: RelNode, context,
                          _split_limit: Optional[int] = None
                          ) -> Optional[Table]:
-    """Execute via the compiled pipeline; None => caller should run eager."""
+    """Execute via the compiled pipeline; None => caller should run eager.
+
+    Plans within the heavy-node budget compile as ONE program (the common
+    case).  Larger plans run as a stage graph of bounded programs —
+    ``_split_limit`` overrides the budget (recursion from the two-strike
+    crash recovery and tests use it; cache keys line up with an explicit
+    ``DSQL_STAGE_HEAVY`` run at the same value).
+    """
     if os.environ.get("DSQL_COMPILE", "1") == "0":
         return None
     from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
 
-    # fingerprint ONCE: the same (plan_fp, input_fp, backend) tuple serves
-    # the split-hint lookup here and base_key below (recomputed only when
-    # host-sort peeling changes the plan, which never happens on TPU —
-    # the only backend hints are written for)
     scans: list = []
     try:
         plan_fp = _fp_plan(plan, context, scans)
@@ -2296,20 +2378,45 @@ def try_execute_compiled(plan: RelNode, context,
         return None
     base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()))
 
-    split_limit = _split_limit
-    if split_limit is None and _heavy_count(plan) > 1:
-        # learned split hint: a plan whose whole program crashed the
+    budget_override = _split_limit
+    heavy = _heavy_count(plan)
+    if budget_override is None and heavy > 1:
+        # learned budget hint: a plan whose whole program crashed the
         # remote TPU compiler (observed: helper SIGSEGV / silent loss on
         # TPC-H Q3's fused sort-pipeline) carries "__split__" in its
-        # learned-caps entry, so every later process splits it immediately
+        # learned-caps entry, so every later process stages it immediately
         # instead of re-crashing the compiler
         hint = _learned_caps_get(base_key).get("__split__")
         if hint is not None:
-            split_limit = int(hint)
-    split_at = _split_point(plan, split_limit)
-    if split_at is not None:
-        return _execute_split(plan, split_at, context,
-                              split_limit=split_limit)
+            budget_override = int(hint)
+    budget = stage_budget(budget_override)
+    if heavy > budget:
+        graph = _partition_plan(plan, budget, context)
+        if len(graph.stages) > 1:
+            return _execute_stage_graph(graph, context, plan_fp,
+                                        _split_limit)
+        # degenerate: nothing cuttable (one oversized node) — run whole
+    return _execute_single(plan, context, plan_fp, _split_limit)
+
+
+def _execute_single(plan: RelNode, context, query_fp: str,
+                    split_limit: Optional[int] = None,
+                    in_stage: bool = False) -> Optional[Table]:
+    """Trace/compile/run ONE bounded program (a whole small plan or one
+    stage of a graph); None => eager.  ``query_fp`` is the ROOT query's
+    plan fingerprint — a cache hit whose entry was compiled under a
+    different root is a cross-query stage reuse and is counted as such."""
+    from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
+
+    scans: list = []
+    try:
+        plan_fp = _fp_plan(plan, context, scans)
+    except Unsupported as e:
+        logger.debug("not compilable: %s", e)
+        stats["unsupported"] += 1
+        return None
+    base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()))
+
     host_sort = None
     if not _on_tpu() and isinstance(plan, LogicalSort):
         # Terminal ORDER BY/LIMIT runs on the HOST off-TPU: the result is
@@ -2338,92 +2445,137 @@ def try_execute_compiled(plan: RelNode, context,
     # the exact Tables via uid — a reload with corrected data must get a
     # fresh chance at the compiled path, not inherit the old dataset's exile
     runtime_key = (base_key, tuple(t.uid for _, t, _ in scans))
-    if runtime_key in _runtime_eager:
+    with _state_lock:
+        exiled_runtime = runtime_key in _runtime_eager
+    if exiled_runtime:
         stats["fallbacks"] += 1
         return None
     caps: Dict[str, int] = _learned_caps_get(base_key)
-    # "__split__" is the learned split hint, not an aggregate-site cap: it
+    # "__split__" is the learned budget hint, not an aggregate-site cap: it
     # must not leak into the program cache key or _build's cap lookups
     caps.pop("__split__", None)
     for _ in range(8):  # capacity-escalation bound
         key = (base_key, tuple(sorted(caps.items())))
-        entry = _cache.get(key)
+        my_event = None
+        with _state_lock:
+            entry = _cache.get(key)
+            if entry is None:
+                other = _inflight.get(key)
+                if other is None:
+                    my_event = _threading.Event()
+                    _inflight[key] = my_event
+        if entry is None and my_event is None:
+            # another thread is compiling this exact program (concurrent
+            # warmup of queries sharing a stage): wait for its verdict
+            # instead of compiling a duplicate
+            other.wait(1800)
+            with _state_lock:
+                entry = _cache.get(key)
+                if entry is None:
+                    # builder failed transiently — take over the build
+                    my_event = _threading.Event()
+                    _inflight[key] = my_event
         if entry is _UNSUPPORTED:
+            if my_event is not None:
+                with _state_lock:
+                    _inflight.pop(key, None)
+                my_event.set()
             stats["unsupported"] += 1
             return None
         flat = _flatten_tables(scans)
         if entry is None:
-            while len(_cache) >= _CACHE_LIMIT:
-                _cache.popitem(last=False)
             try:
-                entry = _build(plan, context, scans, caps, key)
-                outs = entry.fn(*flat)  # first call traces & compiles
-            except Unsupported as e:
-                logger.debug("not compilable at trace time: %s", e)
-                _cache[key] = _UNSUPPORTED
-                stats["unsupported"] += 1
-                return None
-            except (KeyboardInterrupt, SystemExit):
-                raise
-            except Exception as e:
-                # trace-time concretization errors (host-bound kernels) and
-                # backend compile failures (e.g. an op outside the TPU X64
-                # rewrite) both land here: the eager path is the answer.
-                # Backend errors can also be TRANSIENT (a remote-TPU tunnel
-                # dropping mid-compile), so the verdict only sticks after a
-                # second failure — one retry on the next call is cheap
-                # against permanently exiling a hot plan to the eager path.
-                logger.warning("compiled path failed for this plan (%s: %s); "
-                               "using eager executor", type(e).__name__,
-                               str(e)[:200])
-                fails = _compile_failures.get(key, 0) + 1
-                _bounded_put(_compile_failures, key, fails)
-                if fails >= 2:
-                    if (_split_limit is None and _on_tpu()
-                            and _heavy_count(plan) > 1):
-                        # TWO consecutive whole-plan compile failures
-                        # (observed: remote helper SIGSEGV on fused
-                        # sort-pipelines) — one failure may be a transient
-                        # tunnel drop, two is a verdict on the program.
-                        # Learn a persistent "split to 1" hint for this
-                        # plan shape and retry immediately as small
-                        # programs; every later process reads the hint and
-                        # never re-crashes the compiler
-                        stats["split_hints"] += 1
-                        _learned_caps_put(base_key,
-                                          {**_learned_caps_get(base_key),
-                                           "__split__": 1})
-                        logger.warning(
-                            "whole-plan compile failed twice (%s); learned "
-                            "split hint, retrying as split programs",
-                            type(e).__name__)
-                        _compile_failures.pop(key, None)
-                        return try_execute_compiled(plan, context,
-                                                    _split_limit=1)
-                    _cache[key] = _UNSUPPORTED
+                try:
+                    entry = _build(plan, context, scans, caps, key,
+                                   origin=query_fp)
+                    outs = entry.fn(*flat)  # first call traces & compiles
+                except Unsupported as e:
+                    logger.debug("not compilable at trace time: %s", e)
+                    with _state_lock:
+                        _cache[key] = _UNSUPPORTED
                     stats["unsupported"] += 1
-                else:
-                    # first strike may be transient — not exiled (yet)
-                    stats["compile_errors"] += 1
-                if os.environ.get("DSQL_EAGER_FALLBACK", "1") == "0":
-                    # benchmark mode: over a tunneled TPU the eager path is
-                    # thousands of ~100 ms round trips — failing fast beats
-                    # wedging the whole run behind one broken program
+                    return None
+                except (KeyboardInterrupt, SystemExit):
                     raise
-                return None
-            stats["compiles"] += 1
-            _cache[key] = entry
-            # a clean compile clears the strike counter: only CONSECUTIVE
-            # failures exile a plan (transient tunnel drops must not
-            # accumulate across the cache's lifetime)
-            _compile_failures.pop(key, None)
+                except Exception as e:
+                    # trace-time concretization errors (host-bound kernels)
+                    # and backend compile failures (e.g. an op outside the
+                    # TPU X64 rewrite) both land here: the eager path is
+                    # the answer.  Backend errors can also be TRANSIENT (a
+                    # remote-TPU tunnel dropping mid-compile), so the
+                    # verdict only sticks after a second failure — one
+                    # retry on the next call is cheap against permanently
+                    # exiling a hot plan to the eager path.
+                    logger.warning(
+                        "compiled path failed for this plan (%s: %s); "
+                        "using eager executor", type(e).__name__,
+                        str(e)[:200])
+                    stats["compile_errors"] += 1
+                    with _state_lock:
+                        fails = _compile_failures.get(key, 0) + 1
+                        _bounded_put(_compile_failures, key, fails)
+                    if fails >= 2:
+                        if (split_limit is None and _on_tpu()
+                                and _heavy_count(plan) > 1):
+                            # TWO consecutive compile failures (observed:
+                            # remote helper SIGSEGV on fused
+                            # sort-pipelines) — one failure may be a
+                            # transient tunnel drop, two is a verdict on
+                            # the program.  Learn a persistent "stage at
+                            # budget 1" hint for this plan shape and retry
+                            # immediately as minimal programs; every later
+                            # process reads the hint and never re-crashes
+                            # the compiler
+                            stats["split_hints"] += 1
+                            _learned_caps_put(
+                                base_key, {**_learned_caps_get(base_key),
+                                           "__split__": 1})
+                            logger.warning(
+                                "program compile failed twice (%s); "
+                                "learned stage hint, retrying as bounded "
+                                "stages", type(e).__name__)
+                            with _state_lock:
+                                _compile_failures.pop(key, None)
+                            return try_execute_compiled(plan, context,
+                                                        _split_limit=1)
+                        with _state_lock:
+                            _cache[key] = _UNSUPPORTED
+                        stats["exiled"] += 1
+                    if os.environ.get("DSQL_EAGER_FALLBACK", "1") == "0":
+                        # benchmark mode: over a tunneled TPU the eager
+                        # path is thousands of ~100 ms round trips —
+                        # failing fast beats wedging the whole run behind
+                        # one broken program
+                        raise
+                    return None
+                stats["compiles"] += 1
+                if in_stage:
+                    stats["stage_compiles"] += 1
+                with _state_lock:
+                    while len(_cache) >= _CACHE_LIMIT:
+                        _cache.popitem(last=False)
+                    _cache[key] = entry
+                    # a clean compile clears the strike counter: only
+                    # CONSECUTIVE failures exile a plan (transient tunnel
+                    # drops must not accumulate across the cache lifetime)
+                    _compile_failures.pop(key, None)
+            finally:
+                if my_event is not None:
+                    with _state_lock:
+                        _inflight.pop(key, None)
+                    my_event.set()
         else:
             stats["hits"] += 1
-            _cache.move_to_end(key)
+            if in_stage:
+                stats["stage_hits"] += 1
+            if entry.origin is not None and entry.origin != query_fp:
+                stats["cross_query_hits"] += 1
+            with _state_lock:
+                _cache.move_to_end(key)
             if os.environ.get("DSQL_TIME_DEVICE"):
                 # diagnostic split of exec wall: dispatch+device compute
-                # (block_until_ready) vs host materialize/decode.  Costs one
-                # extra device sync per call, so opt-in only.
+                # (block_until_ready) vs host materialize/decode.  Costs
+                # one extra device sync per call, so opt-in only.
                 t0 = time.perf_counter()
                 outs = entry.fn(*flat)
                 jax.block_until_ready(outs)
@@ -2447,7 +2599,8 @@ def try_execute_compiled(plan: RelNode, context,
             # runtime invariant failed (non-unique build / hash collision):
             # the verdict is stable for THESE tables (uid-keyed), so go
             # straight to eager on every future call against them
-            _bounded_put(_runtime_eager, runtime_key, True)
+            with _state_lock:
+                _bounded_put(_runtime_eager, runtime_key, True)
         elif host_sort is not None:
             from ..ops import sort as S
             if host_sort.collation:

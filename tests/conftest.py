@@ -19,7 +19,12 @@ import jax
 # the image profile pins JAX_PLATFORMS=axon (the tunneled TPU); tests run on a
 # virtual 8-device CPU mesh — config.update wins over the plugin registration
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices option; the XLA_FLAGS
+    # host-platform fallback set above (before the jax import) covers it
+    pass
 
 # The persistent compile cache is OPT-IN for tests (DSQL_TEST_CACHE=1).
 # Two reasons, both observed as hard SIGSEGVs on other machines:
@@ -221,7 +226,13 @@ def _normalize(df: pd.DataFrame) -> pd.DataFrame:
                 "Int8", "Int16", "Int32", "Int64", "UInt8", "UInt16", "UInt32",
                 "UInt64", "Float32", "Float64"):
                 out[col] = s.astype("float64")
-        except (TypeError, AttributeError):
+            elif s.dtype.kind in "Mm":
+                # pandas >= 2 keeps non-ns datetime64/timedelta64 resolutions
+                # (the engine emits [us]); assert_frame_equal(check_dtype=
+                # False) still compares the RAW int arrays, so unify units
+                out[col] = s.astype(f"{s.dtype.name.split('[')[0]}[ns]")
+        except (TypeError, AttributeError, OverflowError,
+                pd.errors.OutOfBoundsDatetime):
             pass
     out.columns = [str(cname) for cname in out.columns]
     return out.reset_index(drop=True)

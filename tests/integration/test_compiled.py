@@ -425,24 +425,29 @@ def test_compiled_path_uses_device_string_bitmap(monkeypatch):
     assert strings_fast.stats["device_bitmaps"] > before_dev  # device path
 
 
-def test_plan_splitting_matches_whole(monkeypatch):
-    """Plans above DSQL_SPLIT_HEAVY heavy nodes execute as two compiled
-    programs with a materialized temp between them (XLA:TPU compile time
-    grows superlinearly with fused join count; TPC-H Q2's 9-heavy program
-    never finished compiling over the tunnel).  Forced low threshold: the
-    split path must agree with the unsplit/eager answer and leave no temp
-    schema behind."""
+@pytest.mark.parametrize("workers", ["1", "4"])
+def test_plan_splitting_matches_whole(monkeypatch, workers):
+    """Plans above the heavy-node budget execute as a stage graph of
+    bounded compiled programs with materialized temps between them (XLA:TPU
+    compile time grows superlinearly with fused join count; TPC-H Q2's
+    9-heavy program never finished compiling over the tunnel).  Forced low
+    budget via the legacy DSQL_SPLIT_HEAVY knob (compat path): the staged
+    answer must agree with the eager answer and leave no temp schema
+    behind — in both the serial and the worker-pool executor."""
     import pandas as pd
 
     from benchmarks.tpch import QUERIES, generate_tpch
     from dask_sql_tpu import Context
+    from dask_sql_tpu.physical import compiled as cm
 
     monkeypatch.setenv("DSQL_SPLIT_HEAVY", "3")
+    monkeypatch.setenv("DSQL_COMPILE_WORKERS", workers)
     monkeypatch.delenv("DSQL_STRATEGY", raising=False)
     data = generate_tpch(0.005)
     c1 = Context()
     for n, f in data.items():
         c1.create_table(n, f)
+    graphs = cm.stats["stage_graphs"]
     for q in (2, 21, 18):
         got = c1.sql(QUERIES[q], return_futures=False)
         monkeypatch.setenv("DSQL_COMPILE", "0")
@@ -454,13 +459,14 @@ def test_plan_splitting_matches_whole(monkeypatch):
         split_schema = c1.schema.get("__split__")
         assert not (split_schema and split_schema.tables), \
             "split temps must be cleaned up"
+    assert cm.stats["stage_graphs"] > graphs, "no plan was staged"
 
 
 def test_learned_split_hint(monkeypatch, tmp_path):
-    """A persisted "__split__" caps hint makes the plan execute as split
-    programs (same answer), without env DSQL_SPLIT_HEAVY — the mechanism
-    that stops a plan whose whole program crashes the remote TPU compiler
-    from re-crashing it in every process."""
+    """A persisted "__split__" caps hint makes the plan execute as a stage
+    graph (same answer), without any env knob — the mechanism that stops a
+    plan whose whole program crashes the remote TPU compiler from
+    re-crashing it in every process."""
     import pandas as pd
 
     from benchmarks.tpch import QUERIES, generate_tpch
@@ -475,18 +481,18 @@ def test_learned_split_hint(monkeypatch, tmp_path):
     for n, f in data.items():
         c.create_table(n, f)
 
-    splits = []
-    orig = cm._execute_split
+    staged = []  # stage counts of each graph execution
+    orig = cm._execute_stage_graph
 
-    def spy(plan, node, context, split_limit=None):
-        splits.append(split_limit)
-        return orig(plan, node, context, split_limit)
+    def spy(graph, context, query_fp, split_limit):
+        staged.append(len(graph.stages))
+        return orig(graph, context, query_fp, split_limit)
 
-    monkeypatch.setattr(cm, "_execute_split", spy)
+    monkeypatch.setattr(cm, "_execute_stage_graph", spy)
 
-    # no hint: Q3 (3 heavy nodes, default threshold 6) runs unsplit
+    # no hint: Q3 (3 heavy nodes, default budget 6) runs as one program
     got1 = c.sql(QUERIES[3], return_futures=False)
-    assert splits == []
+    assert staged == []
 
     # write the hint for this exact plan shape, as the failure path would
     from dask_sql_tpu.sql.parser import parse_sql
@@ -498,7 +504,7 @@ def test_learned_split_hint(monkeypatch, tmp_path):
     cm._learned_caps_put(key, {"__split__": 1})
 
     got2 = c.sql(QUERIES[3], return_futures=False)
-    assert splits and splits[0] == 1, "hint must force the split path"
+    assert staged and staged[0] >= 2, "hint must force the staged path"
     pd.testing.assert_frame_equal(got1.reset_index(drop=True),
                                   got2.reset_index(drop=True),
                                   check_dtype=False, rtol=1e-5, atol=1e-8)
@@ -506,9 +512,85 @@ def test_learned_split_hint(monkeypatch, tmp_path):
     # a FRESH process state (cleared memo) still reads the hint from disk
     monkeypatch.setattr(cm, "_caps_disk", None)
     monkeypatch.setattr(cm, "_learned_caps", type(cm._learned_caps)())
-    splits.clear()
+    staged.clear()
     c.sql(QUERIES[3], return_futures=False)
-    assert splits and splits[0] == 1
+    assert staged and staged[0] >= 2
+
+
+@_needs_compiled
+def test_cross_query_stage_cache_hit(monkeypatch):
+    """Two queries sharing a subplan must share the shared stage's compiled
+    program: the second query's stage comes back as a cache hit from a
+    DIFFERENT origin query — observable as stats["cross_query_hits"]."""
+    import numpy as np
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.physical import compiled as cm
+
+    monkeypatch.setenv("DSQL_STAGE_HEAVY", "1")
+    rng = np.random.RandomState(0)
+    c = Context()
+    c.create_table("xq_fact", pd.DataFrame(
+        {"k": rng.randint(0, 50, 1000), "v": rng.rand(1000)}))
+    c.create_table("xq_dim", pd.DataFrame(
+        {"k": np.arange(50), "w": np.arange(50) * 0.5}))
+    shared = "(SELECT k, SUM(v) AS s FROM xq_fact GROUP BY k) x"
+    before = dict(cm.stats)
+    c.sql(f"SELECT x.k, x.s, d.w FROM {shared} "
+          "JOIN xq_dim d ON x.k = d.k", return_futures=False)
+    assert cm.stats["stage_graphs"] > before["stage_graphs"]
+    assert cm.stats["cross_query_hits"] == before["cross_query_hits"]
+    c.sql(f"SELECT x.k, x.s * 2 AS s2, d.w FROM {shared} "
+          "JOIN xq_dim d ON x.k = d.k WHERE d.w > 5", return_futures=False)
+    assert cm.stats["cross_query_hits"] > before["cross_query_hits"], \
+        "shared subplan stage did not hit across queries"
+
+
+def test_stage_temps_cleaned_on_exception(monkeypatch):
+    """__split__ temp tables must be unregistered even when a stage raises
+    mid-graph (the exception path of _execute_stage_graph's cleanup)."""
+    import numpy as np
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.physical import compiled as cm
+    from dask_sql_tpu.sql.parser import parse_sql
+
+    monkeypatch.setenv("DSQL_STAGE_HEAVY", "1")
+    monkeypatch.setenv("DSQL_COMPILE_WORKERS", "1")  # deterministic order
+    rng = np.random.RandomState(0)
+    c = Context()
+    c.create_table("exc_fact", pd.DataFrame(
+        {"k": rng.randint(0, 20, 500), "v": rng.rand(500)}))
+    c.create_table("exc_dim", pd.DataFrame(
+        {"k": np.arange(20), "w": np.arange(20) * 1.5}))
+    plan = c._get_plan(parse_sql(
+        "SELECT x.k, x.s, d.w FROM (SELECT k, SUM(v) AS s FROM exc_fact "
+        "GROUP BY k) x JOIN exc_dim d ON x.k = d.k")[0].query)
+
+    graphs = []
+    orig_part = cm._partition_plan
+
+    def part_spy(p, budget, context):
+        g = orig_part(p, budget, context)
+        graphs.append(g)
+        return g
+
+    orig_single = cm._execute_single
+
+    def boom(p, context, query_fp, split_limit=None, in_stage=False):
+        if graphs and p is graphs[-1].stages[-1].plan:
+            raise RuntimeError("injected root-stage failure")
+        return orig_single(p, context, query_fp, split_limit,
+                           in_stage=in_stage)
+
+    monkeypatch.setattr(cm, "_partition_plan", part_spy)
+    monkeypatch.setattr(cm, "_execute_single", boom)
+    with pytest.raises(RuntimeError, match="injected"):
+        cm.try_execute_compiled(plan, c)
+    assert graphs, "plan was not staged"
+    split_schema = c.schema.get("__split__")
+    assert not (split_schema and split_schema.tables), \
+        "exception path leaked __split__ temps"
 
 
 def test_filter_compaction_learned_caps(monkeypatch):

@@ -1,0 +1,117 @@
+"""Stage-graph partitioner unit tests (physical/stages.py).
+
+The partitioner must be a pure, deterministic function of the plan: the
+compiled executor's program-cache keys flow through the boundary names, so
+a nondeterministic cut would recompile on every run; and the bottom-up
+greedy walk must be ancestor-independent so shared subplans cut
+identically across queries (the cross-query reuse property)."""
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu.physical import stages as S
+from dask_sql_tpu.plan.nodes import LogicalTableScan
+from dask_sql_tpu.sql.parser import parse_sql
+
+
+@pytest.fixture()
+def ctx():
+    c = Context()
+    c.create_table("f", pd.DataFrame({"k": [1, 2, 3, 1], "v": [1.0, 2.0, 3.0, 4.0]}))
+    c.create_table("d", pd.DataFrame({"k": [1, 2, 3], "w": [10, 20, 30]}))
+    c.create_table("e", pd.DataFrame({"k": [1, 2], "z": [5, 6]}))
+    return c
+
+
+def _plan(c, sql):
+    return c._get_plan(parse_sql(sql)[0].query)
+
+
+THREE_HEAVY = ("SELECT x.k, x.s, d.w, e.z FROM "
+               "(SELECT k, SUM(v) AS s FROM f GROUP BY k) x "
+               "JOIN d ON x.k = d.k JOIN e ON x.k = e.k")
+
+
+def _counting_namer():
+    names = {}
+
+    def make_scan(sub):
+        from dask_sql_tpu.plan.nodes import Field
+        name = f"s{len(names)}"
+        names[name] = sub
+        return LogicalTableScan(
+            schema_name="__split__", table_name=name,
+            schema=[Field(f"c{i}", f.stype)
+                    for i, f in enumerate(sub.schema)])
+
+    return make_scan
+
+
+def test_heavy_count_and_node_weight(ctx):
+    plan = _plan(ctx, THREE_HEAVY)
+    assert S.heavy_count(plan) == 3  # two joins + one aggregate
+    assert S.heavy_count(_plan(ctx, "SELECT k FROM f WHERE k > 1")) == 0
+
+
+def test_heavy_count_deterministic(ctx):
+    p1 = _plan(ctx, THREE_HEAVY)
+    p2 = _plan(ctx, THREE_HEAVY)
+    assert S.heavy_count(p1) == S.heavy_count(p2)
+
+
+def test_partition_deterministic(ctx):
+    plan = _plan(ctx, THREE_HEAVY)
+    g1 = S.partition(plan, 1, _counting_namer())
+    g2 = S.partition(plan, 1, _counting_namer())
+    assert len(g1.stages) == len(g2.stages)
+    for a, b in zip(g1.stages, g2.stages):
+        assert a.deps == b.deps
+        assert a.heavy == b.heavy
+        assert a.plan.explain() == b.plan.explain()
+
+
+def test_partition_bounds_and_topology(ctx):
+    plan = _plan(ctx, THREE_HEAVY)
+    for budget in (1, 2, 3):
+        g = S.partition(plan, budget, _counting_namer())
+        total = 0
+        for i, st in enumerate(g.stages):
+            # bound: no stage exceeds max(budget, single-node weight)
+            assert st.heavy <= max(budget, 2)
+            # topological: deps strictly precede their consumer
+            assert all(d < i for d in st.deps)
+            # no stage is a bare boundary/table scan (zero-work program)
+            assert not isinstance(st.plan, LogicalTableScan)
+            total += st.heavy
+        assert total == S.heavy_count(plan)  # cuts never lose heavy nodes
+        assert g.root is g.stages[-1] and g.root.scan is None
+        if budget >= 3:
+            assert len(g.stages) == 1  # within budget: no cuts
+
+
+def test_partition_shared_subtree_is_ancestor_independent(ctx):
+    """The cuts inside a subtree depend only on that subtree: the same
+    subplan embedded under different parents partitions identically —
+    the property cross-query stage reuse rests on."""
+    qa = ("SELECT x.k, x.s, d.w FROM "
+          "(SELECT k, SUM(v) AS s FROM f GROUP BY k) x "
+          "JOIN d ON x.k = d.k")
+    qb = ("SELECT x.k, x.s * 2 AS s2, d.w FROM "
+          "(SELECT k, SUM(v) AS s FROM f GROUP BY k) x "
+          "JOIN d ON x.k = d.k WHERE d.w > 15")
+    ga = S.partition(_plan(ctx, qa), 1, _counting_namer())
+    gb = S.partition(_plan(ctx, qb), 1, _counting_namer())
+    # the shared GROUP BY subtree is cut as the first stage in both
+    assert ga.stages[0].plan.explain() == gb.stages[0].plan.explain()
+
+
+def test_stage_budget_env(monkeypatch):
+    monkeypatch.delenv("DSQL_STAGE_HEAVY", raising=False)
+    monkeypatch.delenv("DSQL_SPLIT_HEAVY", raising=False)
+    assert S.stage_budget() == S.DEFAULT_STAGE_HEAVY
+    assert S.stage_budget(3) == 3
+    monkeypatch.setenv("DSQL_SPLIT_HEAVY", "4")  # legacy knob honored
+    assert S.stage_budget() == 4
+    monkeypatch.setenv("DSQL_STAGE_HEAVY", "2")  # new knob wins
+    assert S.stage_budget() == 2
+    assert S.stage_budget(1) == 1  # explicit override beats both
