@@ -201,13 +201,23 @@ class Context:
     # ------------------------------------------------------------ SQL entry
     def sql(self, sql: str, return_futures: bool = True,
             dataframes: Optional[dict] = None, gpu: bool = False,
-            config_options: Optional[dict] = None) -> Union[Table, Any]:
+            config_options: Optional[dict] = None,
+            timeout: Optional[float] = None) -> Union[Table, Any]:
         """Parse, plan, optimize and execute a SQL statement.
 
         Returns a device ``Table`` (``return_futures=True``, the analogue of
         the reference's lazy dask frame) or a pandas DataFrame
         (``return_futures=False``, the ``.compute()`` path).
+
+        ``timeout`` (seconds) opens a per-query deadline enforced at every
+        layer checkpoint — compile attempts, stage scheduling, streamed
+        batches, eager plan nodes — raising a typed
+        ``runtime.resilience.DeadlineExceeded`` instead of running past the
+        budget.  Defaults to ``DSQL_QUERY_TIMEOUT_MS`` (unset/0 = none);
+        nested calls inherit the sooner enclosing deadline.
         """
+        from .runtime import resilience as _res
+
         if dataframes is not None:
             for df_name, df in dataframes.items():
                 self.create_table(df_name, df, gpu=gpu)
@@ -217,22 +227,23 @@ class Context:
         # device round trip vs host decode — bench.py journals this so a
         # slow query names its own bottleneck
         import time as _time
-        t0 = _time.perf_counter()
-        stmts = parse_sql(sql)
-        timings = {"parse_ms": (_time.perf_counter() - t0) * 1e3,
-                   "plan_ms": 0.0, "exec_ms": 0.0, "fetch_ms": 0.0}
-        self.last_timings = timings
-        result = None
-        for stmt in stmts:
-            result = self._execute_statement(stmt, sql)
-        if result is None:
-            result = Table([], [])
-        if not return_futures and isinstance(result, Table):
+        with _res.query_scope(timeout_s=timeout):
             t0 = _time.perf_counter()
-            result = result.to_pandas()
-            timings["fetch_ms"] = (_time.perf_counter() - t0) * 1e3
+            stmts = parse_sql(sql)
+            timings = {"parse_ms": (_time.perf_counter() - t0) * 1e3,
+                       "plan_ms": 0.0, "exec_ms": 0.0, "fetch_ms": 0.0}
+            self.last_timings = timings
+            result = None
+            for stmt in stmts:
+                result = self._execute_statement(stmt, sql)
+            if result is None:
+                result = Table([], [])
+            if not return_futures and isinstance(result, Table):
+                t0 = _time.perf_counter()
+                result = result.to_pandas()
+                timings["fetch_ms"] = (_time.perf_counter() - t0) * 1e3
+                return result
             return result
-        return result
 
     def _execute_statement(self, stmt: A.Statement, sql: str):
         from .physical.rel.custom import StatementDispatcher

@@ -23,9 +23,16 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..runtime import faults as _faults
+from ..runtime.resilience import UserError
 from ..table import Column, Table, host_encode_series
 
 DEFAULT_BATCH_ROWS = 1 << 22  # 4M rows/batch ~= a few hundred MB on device
+
+
+class ChunkedInputError(UserError, ValueError):
+    """Unrepresentable input shape (typed for the resilience taxonomy;
+    still a ValueError for callers predating the taxonomy)."""
 
 
 class ChunkedSource:
@@ -117,7 +124,7 @@ class ChunkedSource:
         schema = pf.schema_arrow
         for f in schema:
             if patypes.is_nested(f.type):
-                raise ValueError(
+                raise ChunkedInputError(
                     f"from_parquet: column {f.name!r} has nested arrow type "
                     f"{f.type} — not representable as a columnar SQL type")
         str_cols = [f.name for f in schema if _needs_global_dict(f.type)]
@@ -154,7 +161,7 @@ class ChunkedSource:
                     # A column type slipped past _needs_global_dict and got
                     # per-piece local dictionaries; mixing their codes would
                     # silently decode wrong values.
-                    raise ValueError(
+                    raise ChunkedInputError(
                         f"from_parquet: column {name!r} produced differing "
                         "per-piece dictionaries; its arrow type needs a "
                         "global dictionary pass")
@@ -213,9 +220,14 @@ class ChunkedSource:
         return Table(self.names, cols)
 
     def batch_table(self, i: int) -> Tuple[Table, Optional["object"]]:
-        """Device Table for batch i, padded to batch_rows (+ row_valid)."""
+        """Device Table for batch i, padded to batch_rows (+ row_valid).
+
+        The host→device upload is the ``chunked_read`` fault site: the
+        consumer (physical/streaming.py _run_batches) retries transients —
+        the encoded host batch is immutable, so a re-upload is safe."""
         import jax.numpy as jnp
 
+        _faults.maybe_fail("chunked_read")
         enc = self.batches[i]
         n = len(enc[0][0]) if enc else 0
         pad = self.batch_rows - n

@@ -135,6 +135,28 @@ _LIMB_BASE = 4096.0
 _CLASS_LIMBS = {"unit": 1, "int": 5, "float": _LIMBS}
 
 
+def _exact_pow2(n: jax.Array) -> jax.Array:
+    """``2.0**n`` for integer ``n`` in [-1022, 1023] as an EXACT f64 power
+    of two, traced TPU-safely.  Neither standard spelling qualifies:
+    ``ldexp``/``frexp`` on f64 lower to an s64 bitcast-convert the TPU X64
+    rewrite does not implement (hard compile failure on v5e), and XLA's
+    ``exp2`` is exp(n*ln2)-based — off by ulps even at integer arguments,
+    which would silently break the fixed-point grid's exactness contract.
+    Binary exponentiation instead: every factor (2**(2**i)) and every
+    partial product is itself a power of two, so every multiply is exact;
+    the negative half divides 1 by the positive power (exact for normal
+    powers of two)."""
+    n = n.astype(jnp.int32)
+    mag = jnp.abs(n)
+    out = jnp.ones(jnp.shape(n), jnp.float64)
+    base = jnp.float64(2.0)
+    for i in range(10):          # covers |n| <= 1023
+        out = jnp.where(((mag >> i) & 1) == 1, out * base, out)
+        if i < 9:
+            base = base * base   # 2**(2**(i+1)), up to 2**512 — finite
+    return jnp.where(n >= 0, out, 1.0 / out)
+
+
 def _segmented_sums_limbs(vals: jax.Array, codes: jax.Array,
                           mask: jax.Array, num_groups: int,
                           row_classes, interpret: bool) -> jax.Array:
@@ -170,20 +192,34 @@ def _segmented_sums_limbs(vals: jax.Array, codes: jax.Array,
         return jnp.zeros((a, num_groups), jnp.float64)
     g_pad = max(GROUP_TILE, -(-num_groups // GROUP_TILE) * GROUP_TILE)
     cap_bits = 12 * _LIMBS - 1
-    # per-row EXACT power-of-two scale: 1 for unit/int rows; 2**(83-e) for
-    # float rows (frexp: absmax < 2**e strictly, so scaled values < 2**83)
+    # per-row EXACT power-of-two scale: 1 for unit/int rows; ~2**(83-e)
+    # for float rows.  NO frexp/ldexp here: on f64 they lower to an s64
+    # bitcast-convert the TPU X64 rewrite does not implement (verified on
+    # v5e), which killed every f64 static-domain aggregate at compile.
+    # Instead e comes from floor(log2(absmax)) — within 1 ulp of the true
+    # exponent, so TWO bits of slack in cap_bits bound absmax < 2**e
+    # conservatively — and 2**k is built with exp2 of an integer-valued
+    # f64, which is an exact power of two.  The slack costs <= 2 bits of
+    # limb headroom (error bound ~4x, still far below one ulp of the row
+    # maximum).  absmax is taken over MASK-CONTRIBUTING values only: the
+    # engine filters by validity mask without compaction, so a huge value
+    # in a filtered-out row must not coarsen the grid for the whole row
+    # (it would truncate all valid contributions to 0 — silently wrong).
     is_float = np.asarray([c == "float" for c in cls])
     if is_float.any():
-        absmax = jnp.max(jnp.abs(vals), axis=1)
-        e = jnp.frexp(absmax)[1]
-        k = jnp.where(jnp.asarray(is_float),
-                      jnp.clip(cap_bits - e, -1000, 1000), 0)
+        absmax = jnp.max(
+            jnp.where(mask.astype(bool)[None, :], jnp.abs(vals), 0.0),
+            axis=1)
+        e = jnp.floor(jnp.log2(jnp.maximum(absmax, 1e-300))
+                      ).astype(jnp.int32) + 2
+        k = jnp.where(jnp.asarray(is_float) & (absmax > 0),
+                      jnp.clip(cap_bits - e, -940, 1000), 0)
         k = k.astype(jnp.int32)
+        scale = _exact_pow2(k)       # multiplying by these is exact
+        inv = _exact_pow2(-k)
     else:
         k = jnp.zeros((a,), jnp.int32)
-    one = jnp.ones((a,), jnp.float64)
-    scale = jnp.ldexp(one, k)        # multiplying by these is exact
-    inv = jnp.ldexp(one, -k)
+        scale = inv = jnp.ones((a,), jnp.float64)
     # static (row, sign, limb) layout of the limb matrix
     layout = []
     for i, c in enumerate(cls):
@@ -200,9 +236,13 @@ def _segmented_sums_limbs(vals: jax.Array, codes: jax.Array,
         s1 = min(s0 + slab, n)
         ns = s1 - s0
         ns_pad = -(-ns // BLOCK_EXACT) * BLOCK_EXACT
-        v = vals[:, s0:s1] * scale[:, None]
         c = codes[s0:s1].astype(jnp.int32)
         m = mask[s0:s1]
+        # zero masked-out values BEFORE scaling: the grid is sized for the
+        # contributing values only, so a filtered-out outlier could
+        # overflow to inf under the scale and poison the f32 limbs as NaN
+        v = (jnp.where(m.astype(bool)[None, :], vals[:, s0:s1], 0.0)
+             * scale[:, None])
         if ns_pad != ns:
             v = jnp.pad(v, ((0, 0), (0, ns_pad - ns)))
             c = jnp.pad(c, (0, ns_pad - ns))
@@ -260,7 +300,9 @@ def _segmented_sums_limbs(vals: jax.Array, codes: jax.Array,
     sums = [jnp.zeros((num_groups,), jnp.float64)] * a
     comp = [jnp.zeros((num_groups,), jnp.float64)] * a
     for r, (i, s, lk) in enumerate(layout):
-        term = out[r] * (jnp.ldexp(inv[i], 12 * lk) * s)
+        # 2**(12*lk - k[i]) replaces ldexp(inv[i], 12*lk) — the combined
+        # exponent stays in [-1000, 1012], inside _exact_pow2's range
+        term = out[r] * (_exact_pow2(jnp.int32(12 * lk) - k[i]) * s)
         t = sums[i] + term
         comp[i] = comp[i] + jnp.where(
             jnp.abs(sums[i]) >= jnp.abs(term),

@@ -64,6 +64,7 @@ from ..plan.nodes import (
     LogicalTableScan, LogicalUnion, LogicalValues, LogicalWindow, RelNode,
     RexCall, RexInputRef, RexLiteral, RexNode,
 )
+from ..runtime import faults as _faults, resilience as _res
 from ..table import dict_sort_order, Column, Scalar, Table
 from .rex.evaluate import evaluate_predicate, evaluate_rex
 from .stages import (StageGraph, heavy_count as _heavy_count,
@@ -88,7 +89,13 @@ stats = {"compiles": 0, "hits": 0, "fallbacks": 0, "unsupported": 0,
          # DIFFERENT query than the one that compiled the program (the
          # cross-query reuse the stage design exists to create)
          "stage_graphs": 0, "stage_compiles": 0, "stage_hits": 0,
-         "cross_query_hits": 0}
+         "cross_query_hits": 0,
+         # resilience observability (runtime/resilience.py): in-rung
+         # transient retries, ladder rung changes (whole→stages→eager),
+         # deadline verdicts, and per-site fault-injection firings
+         "retries": 0, "degradations": 0, "deadline_exceeded": 0,
+         "fault_compile": 0, "fault_materialize": 0, "fault_stage_exec": 0,
+         "fault_chunked_read": 0, "fault_host_transfer": 0}
 
 # DSQL_TIME_DEVICE=1 diagnostic: per-call split of the execute wall into
 # dispatch+device-compute vs host materialize (see try_execute_compiled)
@@ -1141,6 +1148,15 @@ class _Tracer:
                 vrow = vmask.astype(jnp.float64)
                 crow = vrow
                 rc = "unit"
+            elif agg.op == "COUNT":
+                # COUNT(col): only the 0/1 count row is ever read — ship it
+                # in the value slot too; no 2^53 magnitude guard (sums are
+                # never used, so a huge BIGINT column must not fall back)
+                vmask = col.valid_mask() if fmask is None \
+                    else (col.valid_mask() & fmask)
+                vrow = vmask.astype(jnp.float64)
+                crow = vrow
+                rc = "unit"
             else:
                 vmask = col.valid_mask() if fmask is None \
                     else (col.valid_mask() & fmask)
@@ -1154,9 +1170,10 @@ class _Tracer:
                 if is_int:
                     # the int grid is bit-exact only below 2^53; decimal
                     # scales are pre-gated (p<=15) but a raw BIGINT
-                    # column's magnitude is data-dependent
+                    # column's magnitude is data-dependent (initial= keeps
+                    # the trace alive on 0-row inputs)
                     self.fallback.append(
-                        jnp.max(jnp.abs(vrow)) >= 2.0 ** 53)
+                        jnp.max(jnp.abs(vrow), initial=0.0) >= 2.0 ** 53)
                 rc = "int" if is_int else "float"
             slots.append((j, agg, f, len(mxu_rows), factor))
             mxu_rows.append(vrow)
@@ -1836,7 +1853,6 @@ _cache: "OrderedDict[tuple, object]" = OrderedDict()
 # compiled attempt; bounded like the program cache
 _learned_caps: "OrderedDict[tuple, Dict[str, int]]" = OrderedDict()
 _runtime_eager: "OrderedDict[tuple, bool]" = OrderedDict()
-_compile_failures: "OrderedDict[tuple, int]" = OrderedDict()
 _LEARNED_LIMIT = 1024
 _UNSUPPORTED = object()
 
@@ -2001,6 +2017,50 @@ class _NeedsRecompile(Exception):
         self.caps = caps
 
 
+def _degrade_compile(plan: RelNode, context, base_key, key, exc: Exception,
+                     err, split_limit: Optional[int]) -> Optional[Table]:
+    """One rung down the declared ladder (resilience.LADDER) after a
+    compile failure exhausted its in-rung retries.
+
+    whole → stages: a plan with >1 heavy node re-runs as minimal bounded
+    stages — the production crash pattern (remote helper SIGSEGV on fused
+    sort-pipelines) indicts the oversized PROGRAM, not the plan.  On TPU
+    the verdict persists ("__split__" in the learned caps) so later
+    processes never re-crash the compiler.
+
+    stages / unsplittable → eager: the interpreted executor answers
+    (``None`` tells the caller to run it); with ``DSQL_EAGER_FALLBACK=0``
+    the TYPED error surfaces instead — over a tunneled TPU the eager path
+    is thousands of ~100 ms round trips, and failing fast beats wedging a
+    benchmark behind one broken program.
+
+    A FATAL (non-transient) verdict additionally exiles the program
+    (_UNSUPPORTED) so steady state never re-pays a doomed compile; a
+    transient failure leaves the cache slot empty — the next call gets a
+    fresh attempt, because transient means exactly that.
+    """
+    from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
+    stats["degradations"] += 1
+    if split_limit is None and _heavy_count(plan) > 1:
+        stats["split_hints"] += 1
+        if _on_tpu():
+            _learned_caps_put(base_key, {**_learned_caps_get(base_key),
+                                         "__split__": 1})
+        logger.warning(
+            "program compile failed (%s); degrading to bounded stages",
+            type(exc).__name__)
+        return try_execute_compiled(plan, context, _split_limit=1)
+    if not isinstance(err, _res.TransientError):
+        with _state_lock:
+            _cache[key] = _UNSUPPORTED
+        stats["exiled"] += 1
+    if os.environ.get("DSQL_EAGER_FALLBACK", "1") == "0":
+        raise err if err is exc else err from exc
+    logger.warning("compiled path failed for this plan (%s); using eager "
+                   "executor", str(err)[:200])
+    return None
+
+
 SMALL_FETCH_BYTES = 8 << 20
 
 
@@ -2068,6 +2128,7 @@ def _check_flags(entry: _Compiled, flags) -> None:
 
 
 def _materialize(entry: _Compiled, outs) -> Table:
+    _faults.maybe_fail("materialize")
     meta = entry.meta
     total_bytes = sum(int(getattr(o, "nbytes", 0)) for o in outs)
     if total_bytes <= SMALL_FETCH_BYTES:
@@ -2299,17 +2360,54 @@ def _execute_stage_graph(graph: StageGraph, context, query_fp: str,
     nst = len(stages)
     root_idx = nst - 1
     registered: List[str] = []
+    rt = _res.current()
 
     def run_stage(idx: int) -> Optional[Table]:
-        return _execute_single(stages[idx].plan, context, query_fp,
-                               split_limit, in_stage=True)
+        # worker threads re-enter the query's supervision scope (thread
+        # locals do not cross pools); the stage_exec fault site gets its
+        # own in-place retry so an injected transient behaves like a
+        # recoverable per-stage blip, not a whole-graph failure
+        with _res.scoped(rt):
+            _res.retry_transient(
+                lambda: _faults.maybe_fail("stage_exec"), site="stage_exec")
+            return _execute_single(stages[idx].plan, context, query_fp,
+                                   split_limit, in_stage=True)
+
+    def stage_error(e: Exception) -> Optional[BaseException]:
+        """None => degrade the whole graph to eager; else raise this.
+
+        Only TRANSIENT failures degrade: a stage's own compile ladder
+        already resolved everything recoverable inside _execute_single, so
+        an exception escaping a stage is either a supervision verdict
+        (deadline/cancel), a user error, or a broken invariant — all of
+        which must surface typed, not silently re-run eager."""
+        err = _res.classify(e)
+        if err is None or not isinstance(err, _res.TransientError):
+            return err if err is not None else e
+        if os.environ.get("DSQL_EAGER_FALLBACK", "1") == "0":
+            return err
+        stats["degradations"] += 1
+        logger.warning("stage failed (%s); degrading graph to eager",
+                       str(err)[:200])
+        return None
 
     try:
         workers = _compile_workers(nst)
         if workers == 1:
             # serial: the list is already topological
             for idx, st in enumerate(stages):
-                out = run_stage(idx)
+                _res.check("stage_graph")
+                try:
+                    out = run_stage(idx)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except (_res.DeadlineExceeded, _res.QueryCancelled):
+                    raise
+                except Exception as e:
+                    raised = stage_error(e)
+                    if raised is not None:
+                        raise raised from (None if raised is e else e)
+                    return None
                 if out is None:
                     return None
                 if idx == root_idx:
@@ -2324,20 +2422,39 @@ def _execute_stage_graph(graph: StageGraph, context, query_fp: str,
         done: set = set()
         futs: Dict[object, int] = {}
         failed = False
+        aborted = False
         result: Optional[Table] = None
-        with ThreadPoolExecutor(workers) as pool:
+        pool = ThreadPoolExecutor(workers)
+        try:
             while (pending or futs) and not failed:
+                # cancellation/deadline must cut the GRAPH, not only the
+                # stage bodies: abandon queued stages, orphan in-flight
+                # compiles (the finally's shutdown(wait=False) leaves them
+                # to finish in the background — their programs still land
+                # in the cache for the next query)
+                _res.check("stage_graph")
                 for i in sorted(pending):
                     if all(d in done for d in stages[i].deps):
                         pending.discard(i)
                         futs[pool.submit(run_stage, i)] = i
                 if not futs:
                     break
-                finished, _ = _fwait(list(futs),
+                # bounded wait so a cancel/deadline arriving mid-compile is
+                # observed within ~100 ms instead of after the compile
+                finished, _ = _fwait(list(futs), timeout=0.1,
                                      return_when=FIRST_COMPLETED)
                 for f in finished:
                     i = futs.pop(f)
-                    out = f.result()
+                    try:
+                        out = f.result()
+                    except (KeyboardInterrupt, SystemExit):
+                        raise
+                    except Exception as e:
+                        raised = stage_error(e)
+                        if raised is not None:
+                            raise raised from (None if raised is e else e)
+                        failed = True
+                        continue
                     if out is None:
                         failed = True
                         continue
@@ -2348,6 +2465,11 @@ def _execute_stage_graph(graph: StageGraph, context, query_fp: str,
                             context, stages[i].scan.table_name, out)
                         registered.append(stages[i].scan.table_name)
                     done.add(i)
+        except BaseException:
+            aborted = True
+            raise
+        finally:
+            pool.shutdown(wait=not aborted, cancel_futures=aborted)
         return None if failed else result
     finally:
         for name in registered:
@@ -2361,12 +2483,13 @@ def try_execute_compiled(plan: RelNode, context,
 
     Plans within the heavy-node budget compile as ONE program (the common
     case).  Larger plans run as a stage graph of bounded programs —
-    ``_split_limit`` overrides the budget (recursion from the two-strike
-    crash recovery and tests use it; cache keys line up with an explicit
-    ``DSQL_STAGE_HEAVY`` run at the same value).
+    ``_split_limit`` overrides the budget (recursion from the degradation
+    ladder's whole→stages rung and tests use it; cache keys line up with an
+    explicit ``DSQL_STAGE_HEAVY`` run at the same value).
     """
     if os.environ.get("DSQL_COMPILE", "1") == "0":
         return None
+    _res.check("compile_entry")
     from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
 
     scans: list = []
@@ -2455,6 +2578,7 @@ def _execute_single(plan: RelNode, context, query_fp: str,
     # must not leak into the program cache key or _build's cap lookups
     caps.pop("__split__", None)
     for _ in range(8):  # capacity-escalation bound
+        _res.check("execute")
         key = (base_key, tuple(sorted(caps.items())))
         my_event = None
         with _state_lock:
@@ -2467,8 +2591,12 @@ def _execute_single(plan: RelNode, context, query_fp: str,
         if entry is None and my_event is None:
             # another thread is compiling this exact program (concurrent
             # warmup of queries sharing a stage): wait for its verdict
-            # instead of compiling a duplicate
-            other.wait(1800)
+            # instead of compiling a duplicate — but never past this
+            # query's own deadline
+            rem = None if _res.current() is None \
+                else _res.current().remaining()
+            other.wait(1800 if rem is None else max(min(rem, 1800), 1e-3))
+            _res.check("compile_wait")
             with _state_lock:
                 entry = _cache.get(key)
                 if entry is None:
@@ -2484,86 +2612,71 @@ def _execute_single(plan: RelNode, context, query_fp: str,
             return None
         flat = _flatten_tables(scans)
         if entry is None:
+            degrade = None
             try:
-                try:
-                    entry = _build(plan, context, scans, caps, key,
-                                   origin=query_fp)
-                    outs = entry.fn(*flat)  # first call traces & compiles
-                except Unsupported as e:
-                    logger.debug("not compilable at trace time: %s", e)
-                    with _state_lock:
-                        _cache[key] = _UNSUPPORTED
-                    stats["unsupported"] += 1
-                    return None
-                except (KeyboardInterrupt, SystemExit):
-                    raise
-                except Exception as e:
-                    # trace-time concretization errors (host-bound kernels)
-                    # and backend compile failures (e.g. an op outside the
-                    # TPU X64 rewrite) both land here: the eager path is
-                    # the answer.  Backend errors can also be TRANSIENT (a
-                    # remote-TPU tunnel dropping mid-compile), so the
-                    # verdict only sticks after a second failure — one
-                    # retry on the next call is cheap against permanently
-                    # exiling a hot plan to the eager path.
-                    logger.warning(
-                        "compiled path failed for this plan (%s: %s); "
-                        "using eager executor", type(e).__name__,
-                        str(e)[:200])
-                    stats["compile_errors"] += 1
-                    with _state_lock:
-                        fails = _compile_failures.get(key, 0) + 1
-                        _bounded_put(_compile_failures, key, fails)
-                    if fails >= 2:
-                        if (split_limit is None and _on_tpu()
-                                and _heavy_count(plan) > 1):
-                            # TWO consecutive compile failures (observed:
-                            # remote helper SIGSEGV on fused
-                            # sort-pipelines) — one failure may be a
-                            # transient tunnel drop, two is a verdict on
-                            # the program.  Learn a persistent "stage at
-                            # budget 1" hint for this plan shape and retry
-                            # immediately as minimal programs; every later
-                            # process reads the hint and never re-crashes
-                            # the compiler
-                            stats["split_hints"] += 1
-                            _learned_caps_put(
-                                base_key, {**_learned_caps_get(base_key),
-                                           "__split__": 1})
-                            logger.warning(
-                                "program compile failed twice (%s); "
-                                "learned stage hint, retrying as bounded "
-                                "stages", type(e).__name__)
-                            with _state_lock:
-                                _compile_failures.pop(key, None)
-                            return try_execute_compiled(plan, context,
-                                                        _split_limit=1)
+                attempt = 0
+                while True:  # in-rung transient retries (resilience.LADDER)
+                    try:
+                        _faults.maybe_fail("compile")
+                        entry = _build(plan, context, scans, caps, key,
+                                       origin=query_fp)
+                        outs = entry.fn(*flat)  # first call traces+compiles
+                        break
+                    except Unsupported as e:
+                        logger.debug("not compilable at trace time: %s", e)
                         with _state_lock:
                             _cache[key] = _UNSUPPORTED
-                        stats["exiled"] += 1
-                    if os.environ.get("DSQL_EAGER_FALLBACK", "1") == "0":
-                        # benchmark mode: over a tunneled TPU the eager
-                        # path is thousands of ~100 ms round trips —
-                        # failing fast beats wedging the whole run behind
-                        # one broken program
+                        stats["unsupported"] += 1
+                        return None
+                    except (KeyboardInterrupt, SystemExit):
                         raise
-                    return None
-                stats["compiles"] += 1
-                if in_stage:
-                    stats["stage_compiles"] += 1
-                with _state_lock:
-                    while len(_cache) >= _CACHE_LIMIT:
-                        _cache.popitem(last=False)
-                    _cache[key] = entry
-                    # a clean compile clears the strike counter: only
-                    # CONSECUTIVE failures exile a plan (transient tunnel
-                    # drops must not accumulate across the cache lifetime)
-                    _compile_failures.pop(key, None)
+                    except Exception as e:
+                        # trace-time concretization errors (host-bound
+                        # kernels) and backend compile failures both land
+                        # here, CLASSIFIED (runtime/resilience.py): a
+                        # transient (tunnel drop, device OOM, injected
+                        # fault) retries in-rung with backoff; anything
+                        # else — and exhausted retries — walks the declared
+                        # degradation ladder one rung down
+                        err = _res.classify(e)
+                        if err is None:
+                            raise
+                        if isinstance(err, (_res.DeadlineExceeded,
+                                            _res.QueryCancelled)):
+                            raise err if err is e else err from e
+                        stats["compile_errors"] += 1
+                        attempt += 1
+                        if (isinstance(err, _res.TransientError)
+                                and attempt <= _res.retry_max()):
+                            stats["retries"] += 1
+                            logger.warning(
+                                "transient compile failure (%s); retry "
+                                "%d/%d", str(err)[:200], attempt,
+                                _res.retry_max())
+                            _res.backoff(attempt, "compile")
+                            continue
+                        # degrade OUTSIDE this try: the whole→stages rung
+                        # re-enters try_execute_compiled, which must not
+                        # find this key still in _inflight and wait on
+                        # its own verdict
+                        degrade = (e, err)
+                        break
+                if degrade is None:
+                    stats["compiles"] += 1
+                    if in_stage:
+                        stats["stage_compiles"] += 1
+                    with _state_lock:
+                        while len(_cache) >= _CACHE_LIMIT:
+                            _cache.popitem(last=False)
+                        _cache[key] = entry
             finally:
                 if my_event is not None:
                     with _state_lock:
                         _inflight.pop(key, None)
                     my_event.set()
+            if degrade is not None:
+                return _degrade_compile(plan, context, base_key, key,
+                                        degrade[0], degrade[1], split_limit)
         else:
             stats["hits"] += 1
             if in_stage:
@@ -2585,16 +2698,32 @@ def _execute_single(plan: RelNode, context, query_fp: str,
             else:
                 outs = entry.fn(*flat)
         try:
-            result = _materialize(entry, outs)
-            _mt0 = last_exec_profile.pop("materialize_t0", None)
-            if _mt0 is not None:
-                last_exec_profile["materialize_ms"] = \
-                    (time.perf_counter() - _mt0) * 1e3
+            try:
+                result = _res.retry_transient(
+                    lambda: _materialize(entry, outs), site="materialize",
+                    passthrough=(_NeedsRecompile,))
+            finally:
+                # pop the DSQL_TIME_DEVICE timestamp on EVERY path: a
+                # _NeedsRecompile (or transfer failure) leaking it would
+                # stamp a bogus materialize_ms onto a later untimed call
+                _mt0 = last_exec_profile.pop("materialize_t0", None)
+                if _mt0 is not None:
+                    last_exec_profile["materialize_ms"] = \
+                        (time.perf_counter() - _mt0) * 1e3
         except _NeedsRecompile as r:
             stats["recompiles"] += 1
             caps = r.caps
             _learned_caps_put(base_key, caps)
             continue
+        except _res.TransientError as e:
+            # host decode failed even after retries: one rung down — the
+            # eager executor recomputes from the source tables
+            stats["degradations"] += 1
+            if os.environ.get("DSQL_EAGER_FALLBACK", "1") == "0":
+                raise
+            logger.warning("materialize failed (%s); using eager executor",
+                           str(e)[:200])
+            return None
         if result is None:
             # runtime invariant failed (non-unique build / hash collision):
             # the verdict is stable for THESE tables (uid-keyed), so go

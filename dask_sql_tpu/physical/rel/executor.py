@@ -42,6 +42,11 @@ class RelExecutor(Pluggable):
         self.context = context
 
     def execute(self, rel: RelNode) -> Table:
+        # per-node deadline/cancel checkpoint: the eager path is the
+        # ladder's last compute rung, and a query must not run past its
+        # budget there either (runtime/resilience.py; no-op outside a scope)
+        from ...runtime import resilience as _res
+        _res.check("eager")
         plugin = RelExecutor.get_plugin(type(rel).__name__)
         logger.debug("Executing %s", rel.node_name())
         result = plugin(rel, self)

@@ -65,6 +65,7 @@ from ..plan.nodes import (
     LogicalProject, LogicalSort, LogicalTableScan, LogicalWindow, RelNode,
     RexCall, RexInputRef,
 )
+from ..runtime import faults as _faults, resilience as _res
 from ..table import Table
 from ..types import BIGINT, DOUBLE
 
@@ -80,8 +81,12 @@ PARTIAL_BYTES_BUDGET = int(os.environ.get("DSQL_STREAM_PARTIAL_BYTES",
                                           str(1 << 30)))
 
 
-class StreamingUnsupported(RuntimeError):
-    """Plan shape the streaming executor cannot run out-of-core."""
+class StreamingUnsupported(_res.UserError):
+    """Plan shape the streaming executor cannot run out-of-core.
+
+    A typed UserError (still a RuntimeError via the taxonomy base): the
+    message always names the remedy, and the server maps it to a
+    USER_ERROR payload instead of a stringified internal exception."""
 
 
 # ---------------------------------------------------------------------------
@@ -348,15 +353,23 @@ def _distinct_dedup_shape(agg: LogicalAggregate) -> Optional[int]:
 def _host_partial(result: Table) -> tuple:
     """Fetch a partial result to host NOW: streaming's memory bound is one
     batch resident at a time, so partial outputs must not pin device
-    buffers across iterations. Returns (names, per-col host tuples)."""
+    buffers across iterations. Returns (names, per-col host tuples).
+
+    The device→host fetch is the ``host_transfer`` fault site: over a
+    tunneled TPU it is a network round trip, so transient drops retry with
+    backoff (the device buffers stay alive until the fetch lands)."""
     import jax
 
-    bufs = []
-    for c in result.columns:
-        bufs.append(c.data)
-        if c.mask is not None:
-            bufs.append(c.mask)
-    host = iter(jax.device_get(bufs) if bufs else [])
+    def fetch():
+        _faults.maybe_fail("host_transfer")
+        bufs = []
+        for c in result.columns:
+            bufs.append(c.data)
+            if c.mask is not None:
+                bufs.append(c.mask)
+        return jax.device_get(bufs) if bufs else []
+
+    host = iter(_res.retry_transient(fetch, site="host_transfer"))
     cols = []
     for c in result.columns:
         data = next(host)
@@ -528,7 +541,11 @@ def _run_batches(partial_plan: RelNode, source, context,
 
     acc: List[tuple] = []
     for bi in range(source.n_batches):
-        table, row_valid = source.batch_table(bi)
+        # per-batch checkpoint: a cancelled/over-deadline query must stop
+        # between batches, not grind through the remaining uploads
+        _res.check("stream_batch")
+        table, row_valid = _res.retry_transient(
+            lambda: source.batch_table(bi), site="chunked_read")
         _set_batch_entry(context, table, row_valid)
         result = try_execute_compiled(partial_plan, context)
         if result is None:

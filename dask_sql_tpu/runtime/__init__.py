@@ -1,0 +1,9 @@
+"""Host-side runtime supervision: resilience policy + fault injection.
+
+``resilience``  — typed error taxonomy, per-query deadlines/cancellation,
+                  bounded retry/backoff, and the graceful-degradation ladder
+                  the compile/execute/serve layers share.
+``faults``      — deterministic named-site fault injection so every rung of
+                  the ladder is exercised in CI, not only in production.
+"""
+from . import faults, resilience  # noqa: F401

@@ -1,0 +1,163 @@
+"""Deterministic named-site fault injection.
+
+The degradation ladder (runtime/resilience.py) is only trustworthy if CI
+exercises it; production faults (remote-TPU helper SIGSEGVs, tunnel drops,
+device OOM) cannot be scheduled.  This module plants named injection sites
+at the layer boundaries —
+
+  ``compile``        a stage/whole-plan program build+first call
+                     (physical/compiled.py _execute_single)
+  ``materialize``    decoding a program's outputs to a host Table
+                     (physical/compiled.py _materialize)
+  ``stage_exec``     one stage of a stage-graph execution
+                     (physical/compiled.py _execute_stage_graph)
+  ``chunked_read``   uploading one out-of-HBM batch
+                     (io/chunked.py ChunkedSource.batch_table)
+  ``host_transfer``  fetching streamed partials to host
+                     (physical/streaming.py _host_partial)
+
+— each calling ``maybe_fail(site)``, a no-op unless armed.  Arm via the
+environment, ``DSQL_FAULT_INJECT="site:nth[+][:sleep=MS]"`` (comma-separated
+specs), or the ``inject(...)`` context manager in tests:
+
+  ``compile:1``           the 1st compile call raises FaultInjected
+  ``compile:2+``          every compile call from the 2nd on raises
+  ``compile:1:sleep=500`` the 1st compile call STALLS ~500 ms first (in
+                          cancellable slices) — a deterministic "hung
+                          program" for deadline/cancel tests — then raises
+
+Counters are process-global (sites fire from worker threads) and 1-based;
+a fired fault increments ``compiled.stats["fault_<site>"]``.  FaultInjected
+is a TransientError, so the ordinary retry/degradation machinery handles
+it exactly like the production faults it stands in for.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+from .resilience import TransientError, interruptible_sleep
+
+SITES = ("compile", "materialize", "stage_exec", "chunked_read",
+         "host_transfer")
+
+
+class FaultInjected(TransientError):
+    """An armed injection site fired (stands in for a production fault)."""
+
+    error_name = "FAULT_INJECTED"
+
+    def __init__(self, site: str, nth: int):
+        super().__init__(f"injected fault at site {site!r} (call #{nth})",
+                         kind="injected")
+        self.site = site
+        self.nth = nth
+
+
+class _Spec:
+    __slots__ = ("site", "nth", "from_on", "sleep_ms")
+
+    def __init__(self, site: str, nth: int, from_on: bool,
+                 sleep_ms: Optional[int]):
+        self.site = site
+        self.nth = nth
+        self.from_on = from_on
+        self.sleep_ms = sleep_ms
+
+    def matches(self, count: int) -> bool:
+        return count >= self.nth if self.from_on else count == self.nth
+
+
+def parse_spec(raw: str) -> List[_Spec]:
+    """Parse a DSQL_FAULT_INJECT value; unknown sites/shapes are rejected
+    loudly — a typo must not silently disarm a fault test."""
+    specs: List[_Spec] = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) < 2:
+            raise ValueError(f"DSQL_FAULT_INJECT spec {part!r}: want "
+                             "site:nth[+][:sleep=MS]")
+        site = fields[0]
+        if site not in SITES:
+            raise ValueError(f"DSQL_FAULT_INJECT: unknown site {site!r} "
+                             f"(sites: {', '.join(SITES)})")
+        nth_s = fields[1]
+        from_on = nth_s.endswith("+")
+        nth = int(nth_s[:-1] if from_on else nth_s)
+        sleep_ms = None
+        for extra in fields[2:]:
+            if extra.startswith("sleep="):
+                sleep_ms = int(extra[len("sleep="):])
+            else:
+                raise ValueError(
+                    f"DSQL_FAULT_INJECT: unknown action {extra!r}")
+        specs.append(_Spec(site, nth, from_on, sleep_ms))
+    return specs
+
+
+_lock = threading.Lock()
+_counts: Dict[str, int] = {}
+_override: Optional[List[_Spec]] = None      # inject() context manager
+_env_cache: Tuple[Optional[str], List[_Spec]] = (None, [])
+
+
+def _active_specs() -> List[_Spec]:
+    global _env_cache
+    if _override is not None:
+        return _override
+    raw = os.environ.get("DSQL_FAULT_INJECT")
+    if not raw:
+        return []
+    if _env_cache[0] != raw:
+        _env_cache = (raw, parse_spec(raw))
+    return _env_cache[1]
+
+
+def reset() -> None:
+    """Zero all site counters (between tests / smoke queries)."""
+    with _lock:
+        _counts.clear()
+
+
+def maybe_fail(site: str) -> None:
+    """The injection site.  No-op unless a spec is armed for ``site``."""
+    specs = _active_specs()
+    if not specs:
+        return
+    with _lock:
+        count = _counts.get(site, 0) + 1
+        _counts[site] = count
+        hit = next((s for s in specs
+                    if s.site == site and s.matches(count)), None)
+    if hit is None:
+        return
+    from .resilience import _bump
+    _bump(f"fault_{site}")
+    if hit.sleep_ms:
+        # a "hung program": stall in cancellable slices so deadline/cancel
+        # supervision — not the fault itself — decides the outcome
+        interruptible_sleep(hit.sleep_ms / 1e3, site)
+    raise FaultInjected(site, count)
+
+
+@contextmanager
+def inject(spec: str):
+    """Arm injection for a test body, e.g. ``inject("compile:1")`` or
+    ``inject("stage_exec:1+")``; counters reset on entry AND exit so
+    specs never leak across tests."""
+    global _override
+    parsed = parse_spec(spec)
+    with _lock:
+        prev = _override
+    reset()
+    _override = parsed
+    try:
+        yield
+    finally:
+        _override = prev
+        reset()

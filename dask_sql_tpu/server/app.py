@@ -20,6 +20,8 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
+from ..runtime import resilience as _res
+
 logger = logging.getLogger(__name__)
 
 
@@ -73,7 +75,8 @@ class _QueryInfo:
         self.cache_hits = 0
 
 
-def _run_tracked(context, sql: str, info: _QueryInfo):
+def _run_tracked(context, sql: str, info: _QueryInfo,
+                 cancel: Optional[threading.Event] = None):
     from ..physical import compiled
 
     info.started = time.monotonic()
@@ -82,7 +85,13 @@ def _run_tracked(context, sql: str, info: _QueryInfo):
     # inflate each other's cpu accounting
     cpu0 = time.thread_time()
     try:
-        table = context.sql(sql)
+        # the cancel token joins the query's supervision scope
+        # (runtime/resilience.py): DELETE /v1/cancel sets it and the
+        # execution layers abandon queued stages / orphan in-flight
+        # compiles at their next checkpoint, instead of running to the end
+        # behind a fut.cancel() that cannot stop a started future
+        with _res.query_scope(cancel=cancel):
+            table = context.sql(sql)
     finally:
         info.cpu_sec = time.thread_time() - cpu0
         info.finished = time.monotonic()
@@ -96,8 +105,8 @@ def _run_tracked(context, sql: str, info: _QueryInfo):
         import jax
         mem = jax.local_devices()[0].memory_stats() or {}
         info.peak_memory = int(mem.get("peak_bytes_in_use", 0))
-    except Exception:
-        pass
+    except Exception as e:  # telemetry only; never fail the query over it
+        logger.debug("memory_stats unavailable: %s", e)
     return table
 
 
@@ -146,6 +155,7 @@ class _AppState:
         self.pool = ThreadPoolExecutor(max_workers=4)
         self.future_list: Dict[str, Future] = {}
         self.query_info: Dict[str, _QueryInfo] = {}
+        self.cancel_events: Dict[str, threading.Event] = {}
         self.lock = threading.Lock()
 
 
@@ -190,10 +200,12 @@ def _make_handler(state: _AppState, base_url: str):
                 except Exception as e:
                     del state.future_list[uid]
                     state.query_info.pop(uid, None)
+                    state.cancel_events.pop(uid, None)
                     self._send(200, _error_payload(str(e), uid, exc=e))
                     return
                 del state.future_list[uid]
                 state.query_info.pop(uid, None)
+                state.cancel_events.pop(uid, None)
                 payload = {
                     "id": uid, "infoUri": base_url,
                     "stats": _stats("FINISHED", info),
@@ -214,8 +226,11 @@ def _make_handler(state: _AppState, base_url: str):
             sql = self.rfile.read(length).decode()
             uid = str(uuid_mod.uuid4())
             info = _QueryInfo()
+            cancel = threading.Event()
             state.query_info[uid] = info
-            fut = state.pool.submit(_run_tracked, state.context, sql, info)
+            state.cancel_events[uid] = cancel
+            fut = state.pool.submit(_run_tracked, state.context, sql, info,
+                                    cancel)
             state.future_list[uid] = fut
             self._send(200, {
                 "id": uid, "infoUri": base_url,
@@ -230,9 +245,17 @@ def _make_handler(state: _AppState, base_url: str):
                 uid = self.path[len("/v1/cancel/"):].strip("/")
                 fut = state.future_list.pop(uid, None)
                 state.query_info.pop(uid, None)
+                cancel = state.cancel_events.pop(uid, None)
                 if fut is None:
                     self._send(404, _error_payload("Unknown query id", uid))
                     return
+                # REAL cancellation, not just fut.cancel() (which is a
+                # no-op once the future started): the cancel token makes
+                # the running query raise QueryCancelled at its next
+                # checkpoint — queued stages are abandoned and in-flight
+                # compiles orphaned (physical/compiled.py stage graph)
+                if cancel is not None:
+                    cancel.set()
                 fut.cancel()
                 self._send(200, None)
                 return
@@ -245,16 +268,37 @@ def _error_payload(message: str, uid: str, exc: Exception = None) -> dict:
     """reference responses.py:119-139 ErrorResults shape: the reference's
     QueryError fills errorLocation from the parse error's position
     (``error.from_line + 1``/``from_col + 1``); our ParsingException
-    carries 1-based (line, col) directly."""
+    carries 1-based (line, col) directly.
+
+    Failures ride the typed taxonomy (runtime/resilience.py) onto the
+    wire: ``errorType`` is USER_ERROR / INTERNAL_ERROR /
+    INSUFFICIENT_RESOURCES and ``errorCode``/``errorName`` carry the
+    classified verdict (EXCEEDED_TIME_LIMIT, EXCEEDED_MEMORY_LIMIT,
+    USER_CANCELED, TRANSIENT_ERROR, ...) — not a stringified exception.
+    Unrecognized exceptions escaping ``Context.sql`` classify as user
+    errors at this boundary, preserving the reference's errorName
+    (``str(type(exc))``) for them."""
     line = getattr(exc, "line", None)
     col = getattr(exc, "col", None)
+    error_type, error_code = "USER_ERROR", 0
+    error_name = str(type(exc)) if exc is not None else "GENERIC_ERROR"
+    if exc is not None:
+        err = _res.classify(exc, default=_res.UserError)
+        if isinstance(err, _res.ResilienceError):
+            error_type = err.error_type
+            error_code = err.error_code
+            if (isinstance(err, (_res.TransientError, _res.FatalError,
+                                 _res.DeadlineExceeded, _res.QueryCancelled))
+                    or err is exc):
+                # engine verdicts use the taxonomy name; wrapped user
+                # exceptions keep their own class name (reference shape)
+                error_name = err.error_name
     return {
         "id": uid, "infoUri": "", "stats": _stats("FAILED"),
         "error": {
-            "message": message, "errorCode": 0,
-            "errorName": str(type(exc)) if exc is not None
-            else "GENERIC_ERROR",
-            "errorType": "USER_ERROR",
+            "message": message, "errorCode": error_code,
+            "errorName": error_name,
+            "errorType": error_type,
             "errorLocation": {
                 "lineNumber": line if isinstance(line, int) else 1,
                 "columnNumber": col if isinstance(col, int) else 1,

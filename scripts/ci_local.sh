@@ -30,6 +30,11 @@ python -m pytest tests/integration/test_compiled.py \
 python -m pytest tests/integration/test_tpch.py \
                  tests/integration/test_pandas_oracle.py -q
 
+echo "=== [2b] fault-injection smoke (resilience ladder) ==="
+# the first compile of every query is sabotaged (runtime/faults.py); the
+# ladder must retry/degrade to the same oracle-correct answers
+DSQL_FAULT_INJECT=compile:1 python scripts/fault_smoke.py
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Fault-injection smoke gate: a TPC-H subset under an injected compile
+fault must still produce oracle-correct results via the resilience ladder.
+
+Run by scripts/tier1.sh (and CI) as
+
+    DSQL_FAULT_INJECT=compile:1 python scripts/fault_smoke.py
+
+The spec makes the FIRST compile attempt of every query fail; the engine
+must retry (or degrade) and return the same answer the eager executor
+gives with no fault armed — and ``compiled.stats`` must show the ladder
+actually ran (retries/degradations + fault_* counters), or the injection
+sites have silently rotted.  Any other spec (e.g. ``compile:1+`` to force
+full ladder walks, ``materialize:1``) can be passed through the same env
+var.  Exit 0 on success.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("DSQL_FAULT_INJECT", "compile:1")
+os.environ.setdefault("DSQL_RETRY_BASE_MS", "1")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import pandas as pd  # noqa: E402
+
+from benchmarks.tpch import QUERIES, generate_tpch  # noqa: E402
+from dask_sql_tpu import Context  # noqa: E402
+from dask_sql_tpu.physical import compiled  # noqa: E402
+from dask_sql_tpu.runtime import faults  # noqa: E402
+
+# agg-heavy (Q1), join+agg+topk (Q3), scan/filter (Q6): small but covers
+# the single-program, staged and filter-only compile shapes
+SUBSET = (1, 3, 6)
+SF = 0.002
+
+
+def _norm(df: pd.DataFrame) -> pd.DataFrame:
+    out = df.copy()
+    for col in out.columns:
+        if out[col].dtype.kind in "iuf":
+            out[col] = out[col].astype("float64")
+    return (out.sort_values(list(out.columns), na_position="last")
+               .reset_index(drop=True))
+
+
+def main() -> int:
+    spec = os.environ["DSQL_FAULT_INJECT"]
+    data = generate_tpch(SF)
+    ctx = Context()
+    for name, df in data.items():
+        ctx.create_table(name, df)
+
+    failures = 0
+    for qid in SUBSET:
+        q = QUERIES[qid]
+        # fresh per-site counters: the spec fires on each query's first
+        # compile, not only once per process
+        faults.reset()
+        s0 = {k: compiled.stats[k] for k in
+              ("retries", "degradations", "fault_compile")}
+        got = ctx.sql(q, return_futures=False)
+
+        # oracle: the eager executor, faults disarmed
+        del os.environ["DSQL_FAULT_INJECT"]
+        os.environ["DSQL_COMPILE"] = "0"
+        try:
+            want = ctx.sql(q, return_futures=False)
+        finally:
+            del os.environ["DSQL_COMPILE"]
+            os.environ["DSQL_FAULT_INJECT"] = spec
+
+        fired = compiled.stats["fault_compile"] - s0["fault_compile"]
+        recovered = (compiled.stats["retries"] - s0["retries"]
+                     + compiled.stats["degradations"] - s0["degradations"])
+        try:
+            pd.testing.assert_frame_equal(_norm(got), _norm(want),
+                                          check_dtype=False, rtol=1e-6,
+                                          atol=1e-10)
+        except AssertionError as e:
+            print(f"FAIL q{qid}: wrong result under {spec}\n{e}")
+            failures += 1
+            continue
+        if fired == 0 or recovered == 0:
+            print(f"FAIL q{qid}: fault did not exercise the ladder "
+                  f"(fired={fired}, retries+degradations={recovered})")
+            failures += 1
+            continue
+        print(f"ok q{qid}: correct under {spec} "
+              f"(fired={fired}, retries+degradations={recovered})")
+    if failures:
+        print(f"fault smoke FAILED ({failures}/{len(SUBSET)} queries)")
+        return 1
+    print("fault smoke PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

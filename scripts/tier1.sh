@@ -1,6 +1,15 @@
 #!/usr/bin/env bash
-# Tier-1 verify gate — the ROADMAP.md command, verbatim.  CI, the driver and
-# humans must all run the SAME invocation or "tier-1 green" means different
-# things to each of them.
+# Tier-1 verify gate — the ROADMAP.md command, verbatim, plus the
+# fault-injection smoke.  CI, the driver and humans must all run the SAME
+# invocation or "tier-1 green" means different things to each of them.
 cd "$(dirname "$0")/.."
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+# Fault-injection smoke (runtime/faults.py): a TPC-H subset with the first
+# compile of every query sabotaged must still return oracle-correct results
+# via the resilience ladder (retry/degrade).  Runs only when the suite
+# itself passed, so a red suite keeps its own diagnosis.
+if [ "$rc" -eq 0 ]; then
+  timeout -k 10 600 env JAX_PLATFORMS=cpu DSQL_FAULT_INJECT=compile:1 \
+    python scripts/fault_smoke.py || rc=1
+fi
+exit $rc

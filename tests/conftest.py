@@ -78,10 +78,11 @@ def pytest_collection_modifyitems(items):
 def _bounded_executable_lifetime():
     yield
     from dask_sql_tpu.physical import compiled
+    from dask_sql_tpu.runtime import faults
     compiled._cache.clear()
     compiled._learned_caps.clear()
     compiled._runtime_eager.clear()
-    compiled._compile_failures.clear()
+    faults.reset()
     jax.clear_caches()
 
 

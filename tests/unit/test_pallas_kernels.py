@@ -202,3 +202,61 @@ def test_fixedpoint_zero_row_and_empty_input():
         jnp.zeros((2, 0)), jnp.zeros(0, jnp.int32), jnp.ones(0, bool), 3,
         row_classes=["float", "int"], interpret=True))
     assert np.array_equal(got, np.zeros((2, 3)))
+
+
+# ---------------------------------------------------------------------------
+# TPU-compilability regression (ADVICE r5 high): the f64 fixed-point path
+# must not trace frexp/ldexp — they lower to an s64 bitcast-convert the TPU
+# X64 rewrite does not implement, which silently exiled every f64
+# static-domain aggregate (the Q1 path) to eager.  The CPU-lowered HLO is
+# scanned as a proxy: the banned lowering appears on every backend.
+# ---------------------------------------------------------------------------
+
+def test_exact_pow2_is_exact_over_full_range():
+    n = np.arange(-1000, 1013)
+    got = np.asarray(pk._exact_pow2(jnp.asarray(n, dtype=jnp.int32)))
+    want = np.ldexp(np.ones(len(n)), n)
+    assert (got == want).all()
+
+
+def test_dispatch_compile_smoke_no_64bit_bitcast():
+    """segmented_sums_dispatch's f64 fixed-point route must lower without
+    any 64-bit bitcast-convert (frexp/ldexp would introduce one)."""
+    import os
+
+    import jax
+
+    rng = np.random.RandomState(3)
+    vals = jnp.asarray(np.stack([
+        (rng.rand(512) > 0.5).astype(np.float64),        # 'unit': 0/1
+        rng.randint(-10**9, 10**9, 512).astype(np.float64),  # 'int'
+        rng.randn(512) * 1e5,                            # 'float'
+    ]))
+    codes = jnp.asarray(rng.randint(0, 5, 512))
+    mask = jnp.asarray(rng.rand(512) > 0.2)
+    os.environ["DSQL_PALLAS"] = "force"
+    try:
+        fn = lambda v, c, m: pk.segmented_sums_dispatch(  # noqa: E731
+            v, c, m, 5, row_classes=["unit", "int", "float"])
+        lowered = jax.jit(fn).lower(vals, codes, mask)
+        text = lowered.as_text()
+        assert "bitcast_convert" not in text, (
+            "64-bit bitcast-convert in the lowered module — the TPU X64 "
+            "rewrite cannot compile it")
+        # and it actually compiles + matches the oracle on this backend
+        got = np.asarray(jax.jit(fn)(vals, codes, mask))
+        want = np.asarray(pk.reference_segmented_sums(vals, codes, mask, 5))
+        np.testing.assert_allclose(got, want, rtol=1e-9)
+    finally:
+        del os.environ["DSQL_PALLAS"]
+
+
+def test_fixedpoint_masked_outlier_does_not_coarsen_grid():
+    """ADVICE r5 medium: absmax must cover mask-CONTRIBUTING values only —
+    a filtered-out 1e300 row must not zero the valid sums."""
+    vals = jnp.asarray([[1.0, 2.0, 1e300, 3.0]])
+    codes = jnp.asarray([0, 0, 1, 1])
+    mask = jnp.asarray([True, True, False, True])
+    got = np.asarray(pk.segmented_sums_fixedpoint(
+        vals, codes, mask, 2, row_classes=["float"], interpret=True))
+    np.testing.assert_allclose(got, [[3.0, 3.0]], rtol=1e-12)
