@@ -363,6 +363,20 @@ def _stage_main():
                         bd = {k: round(v, 1) for k, v in t.items()}
                     if left() < 20:
                         break
+                # one extra DSQL_TIME_DEVICE rep: splits the exec wall
+                # into device dispatch+compute vs host materialize (it
+                # costs an extra device sync, so it never contaminates
+                # the recorded best — its split just joins the breakdown)
+                if left() > 30 and "DSQL_TIME_DEVICE" not in os.environ:
+                    os.environ["DSQL_TIME_DEVICE"] = "1"
+                    try:
+                        c.sql(QUERIES[qid], return_futures=False)
+                        t = getattr(c, "last_timings", None) or {}
+                        for k in ("device_ms", "materialize_ms"):
+                            if k in t and bd is not None:
+                                bd[k] = round(t[k], 1)
+                    finally:
+                        del os.environ["DSQL_TIME_DEVICE"]
             except Exception as e:
                 # a tunnel hiccup here must not cost the stage_done record
                 # — every number is already journaled
@@ -578,6 +592,15 @@ def main():
                     "load_sec": round(load_sec, 1),
                     "warmup_compile_sec": round(warmup_sec, 1),
                     "compiled_stats": cstats,
+                    # stage-program cache effectiveness across the run:
+                    # hits / (hits + compiles), the number every perf PR
+                    # watches in the BENCH_r*.json trajectory
+                    "stage_cache_hit_rate": (
+                        round(cstats.get("stage_hits", 0)
+                              / (cstats.get("stage_hits", 0)
+                                 + cstats.get("stage_compiles", 0)), 3)
+                        if (cstats.get("stage_hits", 0)
+                            + cstats.get("stage_compiles", 0)) else None),
                     "device_memory": mem,
                     "budget_sec": TOTAL_BUDGET,
                     "elapsed_sec": round(time.monotonic() - t_start, 1),

@@ -215,8 +215,14 @@ class Context:
         ``runtime.resilience.DeadlineExceeded`` instead of running past the
         budget.  Defaults to ``DSQL_QUERY_TIMEOUT_MS`` (unset/0 = none);
         nested calls inherit the sooner enclosing deadline.
+
+        Every call records a ``runtime.telemetry.QueryReport`` (span tree,
+        phase timings, counter deltas, row/byte counts) on
+        ``self.last_report``; ``DSQL_SLOW_QUERY_MS`` arms a slow-query log
+        and ``DSQL_CHROME_TRACE_DIR`` exports each query's span tree as
+        chrome://tracing JSON.
         """
-        from .runtime import resilience as _res
+        from .runtime import resilience as _res, telemetry as _tel
 
         if dataframes is not None:
             for df_name, df in dataframes.items():
@@ -227,42 +233,72 @@ class Context:
         # device round trip vs host decode — bench.py journals this so a
         # slow query names its own bottleneck
         import time as _time
-        with _res.query_scope(timeout_s=timeout):
-            t0 = _time.perf_counter()
-            stmts = parse_sql(sql)
-            timings = {"parse_ms": (_time.perf_counter() - t0) * 1e3,
-                       "plan_ms": 0.0, "exec_ms": 0.0, "fetch_ms": 0.0}
-            self.last_timings = timings
-            result = None
-            for stmt in stmts:
-                result = self._execute_statement(stmt, sql)
-            if result is None:
-                result = Table([], [])
-            if not return_futures and isinstance(result, Table):
+        trace = None
+        try:
+            with _res.query_scope(timeout_s=timeout), \
+                    _tel.trace_scope(sql) as trace:
                 t0 = _time.perf_counter()
-                result = result.to_pandas()
-                timings["fetch_ms"] = (_time.perf_counter() - t0) * 1e3
+                with _tel.span("parse"):
+                    stmts = parse_sql(sql)
+                timings = {"parse_ms": (_time.perf_counter() - t0) * 1e3,
+                           "plan_ms": 0.0, "exec_ms": 0.0, "fetch_ms": 0.0}
+                self.last_timings = timings
+                result = None
+                for stmt in stmts:
+                    result = self._execute_statement(stmt, sql)
+                if result is None:
+                    result = Table([], [])
+                if trace is not None and isinstance(result, Table):
+                    trace.root.attrs["rows_out"] = result.num_rows
+                    trace.root.attrs["bytes_out"] = sum(
+                        int(getattr(c.data, "nbytes", 0))
+                        for c in result.columns)
+                if not return_futures and isinstance(result, Table):
+                    t0 = _time.perf_counter()
+                    with _tel.span("fetch"):
+                        result = result.to_pandas()
+                    timings["fetch_ms"] = (_time.perf_counter() - t0) * 1e3
+                    return result
                 return result
-            return result
+        finally:
+            # the report is built when the trace CLOSES (the with-exit
+            # above), so it is published here — on success and failure
+            # alike; nested sql() calls (trace is None) ride the outer
+            # query's report instead of overwriting it
+            if trace is not None and trace.report is not None:
+                self.last_report = trace.report
+                timings = getattr(self, "last_timings", None)
+                if timings is not None:
+                    # compile/device/materialize phase split joins the
+                    # bench-journaled breakdown (attributable BENCH_r*.json)
+                    for k in ("compile", "device", "materialize"):
+                        v = trace.report.phases.get(k)
+                        if v is not None:
+                            timings[f"{k}_ms"] = v
 
     def _execute_statement(self, stmt: A.Statement, sql: str):
         from .physical.rel.custom import StatementDispatcher
+        from .runtime import telemetry as _tel
 
         import time as _time
         timings = getattr(self, "last_timings", None)
         if isinstance(stmt, A.QueryStatement):
             t0 = _time.perf_counter()
-            plan = self._get_plan(stmt.query, sql)
+            with _tel.span("plan"):
+                plan = self._get_plan(stmt.query, sql)
             if timings is not None:
                 timings["plan_ms"] += (_time.perf_counter() - t0) * 1e3
                 t0 = _time.perf_counter()
                 try:
-                    return self._execute_query_plan(plan)
+                    with _tel.span("execute"):
+                        return self._execute_query_plan(plan)
                 finally:
                     timings["exec_ms"] += (_time.perf_counter() - t0) * 1e3
-            return self._execute_query_plan(plan)
+            with _tel.span("execute"):
+                return self._execute_query_plan(plan)
         handler = StatementDispatcher.get_plugin(type(stmt).__name__)
-        return handler(stmt, self, sql)
+        with _tel.span("execute", statement=type(stmt).__name__):
+            return handler(stmt, self, sql)
 
     def _execute_query_plan(self, plan):
         from .physical.rel.executor import RelExecutor

@@ -23,7 +23,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..runtime import faults as _faults
+from ..runtime import faults as _faults, telemetry as _tel
 from ..runtime.resilience import UserError
 from ..table import Column, Table, host_encode_series
 
@@ -232,12 +232,15 @@ class ChunkedSource:
         n = len(enc[0][0]) if enc else 0
         pad = self.batch_rows - n
         cols = []
+        upload_bytes = 0
         for ci, (data, mask) in enumerate(enc):
             if pad:
                 data = np.concatenate(
                     [data, np.zeros(pad, dtype=data.dtype)])
                 if mask is not None:
                     mask = np.concatenate([mask, np.zeros(pad, dtype=bool)])
+            upload_bytes += int(data.nbytes) + (
+                int(mask.nbytes) if mask is not None else 0)
             dev = jnp.asarray(data)
             m = None if mask is None else jnp.asarray(mask)
             cols.append(Column(dev, self.stypes[ci], m,
@@ -245,4 +248,8 @@ class ChunkedSource:
         row_valid = None
         if pad:
             row_valid = jnp.arange(self.batch_rows) < n
+        # upload size rides the enclosing stream_batch span: per-batch
+        # host→device traffic is the streaming mode's dominant cost over a
+        # tunneled TPU, so a slow batch should name its own byte count
+        _tel.annotate(upload_bytes=upload_bytes)
         return Table(self.names, cols), row_valid

@@ -64,7 +64,8 @@ from ..plan.nodes import (
     LogicalTableScan, LogicalUnion, LogicalValues, LogicalWindow, RelNode,
     RexCall, RexInputRef, RexLiteral, RexNode,
 )
-from ..runtime import faults as _faults, resilience as _res
+from ..runtime import (faults as _faults, resilience as _res,
+                       telemetry as _tel)
 from ..table import dict_sort_order, Column, Scalar, Table
 from .rex.evaluate import evaluate_predicate, evaluate_rex
 from .stages import (StageGraph, heavy_count as _heavy_count,
@@ -82,24 +83,68 @@ _CACHE_LIMIT = 128
 # ops whose kernels are host-bound or non-deterministic: never compile
 _DENY_OPS = {"RAND", "RAND_INTEGER"}
 
-stats = {"compiles": 0, "hits": 0, "fallbacks": 0, "unsupported": 0,
-         "recompiles": 0, "compile_errors": 0, "exiled": 0, "split_hints": 0,
-         # stage-graph observability: plans partitioned, stage programs
-         # compiled/served from cache, and cache hits arriving from a
-         # DIFFERENT query than the one that compiled the program (the
-         # cross-query reuse the stage design exists to create)
-         "stage_graphs": 0, "stage_compiles": 0, "stage_hits": 0,
-         "cross_query_hits": 0,
-         # resilience observability (runtime/resilience.py): in-rung
-         # transient retries, ladder rung changes (whole→stages→eager),
-         # deadline verdicts, and per-site fault-injection firings
-         "retries": 0, "degradations": 0, "deadline_exceeded": 0,
-         "fault_compile": 0, "fault_materialize": 0, "fault_stage_exec": 0,
-         "fault_chunked_read": 0, "fault_host_transfer": 0}
+# DEPRECATED read-through alias of the telemetry registry's counters
+# (runtime/telemetry.py owns them now; names + meanings unchanged and
+# covered by its stability contract): compiles/hits/fallbacks/unsupported/
+# recompiles/compile_errors/exiled/split_hints, the stage-graph counters
+# (stage_graphs/stage_compiles/stage_hits/cross_query_hits: plans
+# partitioned, stage programs compiled/served from cache, and cache hits
+# arriving from a DIFFERENT query than the one that compiled the program),
+# and the resilience counters (retries/degradations/deadline_exceeded/
+# fault_*).  Reads and ``dict(stats)`` snapshots keep working; increments
+# in NEW code must go through ``telemetry.inc`` (atomic), never
+# ``stats[k] += 1`` (an unlocked read-modify-write).
+stats = _tel.CounterAlias()
+
+
+class _ExecProfileAlias:
+    """DEPRECATED thread-local view of the DSQL_TIME_DEVICE exec split.
+
+    The old process-global dict raced: concurrent server queries clobbered
+    each other's device/materialize timings.  Each query thread now owns
+    its profile (telemetry.exec_profile()) and the authoritative numbers
+    land on the query's span / QueryReport; this alias keeps the
+    ``compiled.last_exec_profile`` surface readable per thread."""
+
+    def get(self, key, default=None):
+        return _tel.exec_profile().get(key, default)
+
+    def pop(self, key, default=None):
+        return _tel.exec_profile().pop(key, default)
+
+    def __getitem__(self, key):
+        return _tel.exec_profile()[key]
+
+    def __setitem__(self, key, value):
+        _tel.exec_profile()[key] = value
+
+    def __contains__(self, key):
+        return key in _tel.exec_profile()
+
+    def __iter__(self):
+        return iter(_tel.exec_profile())
+
+    def __len__(self):
+        return len(_tel.exec_profile())
+
+    def keys(self):
+        # dict(alias) goes through keys(); without it dict() would try to
+        # consume the iterator as key-value PAIRS
+        return _tel.exec_profile().keys()
+
+    def items(self):
+        return _tel.exec_profile().items()
+
+    def clear(self):
+        _tel.exec_profile().clear()
+
+    def __repr__(self):  # pragma: no cover - debugging nicety
+        return repr(_tel.exec_profile())
+
 
 # DSQL_TIME_DEVICE=1 diagnostic: per-call split of the execute wall into
 # dispatch+device-compute vs host materialize (see try_execute_compiled)
-last_exec_profile: Dict[str, float] = {}
+last_exec_profile = _ExecProfileAlias()
 
 
 class Unsupported(Exception):
@@ -2040,9 +2085,10 @@ def _degrade_compile(plan: RelNode, context, base_key, key, exc: Exception,
     fresh attempt, because transient means exactly that.
     """
     from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
-    stats["degradations"] += 1
+    _tel.inc("degradations")
     if split_limit is None and _heavy_count(plan) > 1:
-        stats["split_hints"] += 1
+        _tel.inc("split_hints")
+        _tel.annotate(degraded_to="stages")
         if _on_tpu():
             _learned_caps_put(base_key, {**_learned_caps_get(base_key),
                                          "__split__": 1})
@@ -2050,10 +2096,11 @@ def _degrade_compile(plan: RelNode, context, base_key, key, exc: Exception,
             "program compile failed (%s); degrading to bounded stages",
             type(exc).__name__)
         return try_execute_compiled(plan, context, _split_limit=1)
+    _tel.annotate(degraded_to="eager")
     if not isinstance(err, _res.TransientError):
         with _state_lock:
             _cache[key] = _UNSUPPORTED
-        stats["exiled"] += 1
+        _tel.inc("exiled")
     if os.environ.get("DSQL_EAGER_FALLBACK", "1") == "0":
         raise err if err is exc else err from exc
     logger.warning("compiled path failed for this plan (%s); using eager "
@@ -2138,7 +2185,7 @@ def _materialize(entry: _Compiled, outs) -> Table:
         host = jax.device_get(list(outs))
         flags = host[0]
         if flags[0]:
-            stats["fallbacks"] += 1
+            _tel.inc("fallbacks")
             return None
         _check_flags(entry, flags)
         count = int(flags[1])
@@ -2167,7 +2214,7 @@ def _materialize(entry: _Compiled, outs) -> Table:
 
     flags = np.asarray(outs[0])
     if flags[0]:
-        stats["fallbacks"] += 1
+        _tel.inc("fallbacks")
         return None
     _check_flags(entry, flags)
     count = int(flags[1])
@@ -2355,19 +2402,31 @@ def _execute_stage_graph(graph: StageGraph, context, query_fp: str,
     single-dispatch payoff.  Temp tables are unregistered on EVERY path,
     exceptions included.
     """
-    stats["stage_graphs"] += 1
+    with _tel.span("stage_graph", stages=len(graph.stages)):
+        return _execute_stage_graph_inner(graph, context, query_fp,
+                                          split_limit)
+
+
+def _execute_stage_graph_inner(graph: StageGraph, context, query_fp: str,
+                               split_limit: Optional[int]
+                               ) -> Optional[Table]:
+    _tel.inc("stage_graphs")
     stages = graph.stages
     nst = len(stages)
     root_idx = nst - 1
     registered: List[str] = []
     rt = _res.current()
+    tel_trace = _tel.current_trace()
+    tel_parent = _tel.current_span()
 
     def run_stage(idx: int) -> Optional[Table]:
-        # worker threads re-enter the query's supervision scope (thread
-        # locals do not cross pools); the stage_exec fault site gets its
-        # own in-place retry so an injected transient behaves like a
-        # recoverable per-stage blip, not a whole-graph failure
-        with _res.scoped(rt):
+        # worker threads re-enter the query's supervision scope AND its
+        # telemetry trace (thread locals do not cross pools); the
+        # stage_exec fault site gets its own in-place retry so an injected
+        # transient behaves like a recoverable per-stage blip, not a
+        # whole-graph failure
+        with _res.scoped(rt), _tel.scoped(tel_trace, tel_parent), \
+                _tel.span("stage", index=idx):
             _res.retry_transient(
                 lambda: _faults.maybe_fail("stage_exec"), site="stage_exec")
             return _execute_single(stages[idx].plan, context, query_fp,
@@ -2386,7 +2445,8 @@ def _execute_stage_graph(graph: StageGraph, context, query_fp: str,
             return err if err is not None else e
         if os.environ.get("DSQL_EAGER_FALLBACK", "1") == "0":
             return err
-        stats["degradations"] += 1
+        _tel.inc("degradations")
+        _tel.annotate(degraded_to="eager")
         logger.warning("stage failed (%s); degrading graph to eager",
                        str(err)[:200])
         return None
@@ -2497,7 +2557,7 @@ def try_execute_compiled(plan: RelNode, context,
         plan_fp = _fp_plan(plan, context, scans)
     except Unsupported as e:
         logger.debug("not compilable: %s", e)
-        stats["unsupported"] += 1
+        _tel.inc("unsupported")
         return None
     base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()))
 
@@ -2536,7 +2596,7 @@ def _execute_single(plan: RelNode, context, query_fp: str,
         plan_fp = _fp_plan(plan, context, scans)
     except Unsupported as e:
         logger.debug("not compilable: %s", e)
-        stats["unsupported"] += 1
+        _tel.inc("unsupported")
         return None
     base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()))
 
@@ -2556,7 +2616,7 @@ def _execute_single(plan: RelNode, context, query_fp: str,
             plan_fp = _fp_plan(plan, context, scans)
         except Unsupported as e:
             logger.debug("not compilable: %s", e)
-            stats["unsupported"] += 1
+            _tel.inc("unsupported")
             return None
         # the backend joins the key: tracing picks backend-specific
         # strategies (merge vs gather join), and with content-based input
@@ -2571,7 +2631,7 @@ def _execute_single(plan: RelNode, context, query_fp: str,
     with _state_lock:
         exiled_runtime = runtime_key in _runtime_eager
     if exiled_runtime:
-        stats["fallbacks"] += 1
+        _tel.inc("fallbacks")
         return None
     caps: Dict[str, int] = _learned_caps_get(base_key)
     # "__split__" is the learned budget hint, not an aggregate-site cap: it
@@ -2608,63 +2668,68 @@ def _execute_single(plan: RelNode, context, query_fp: str,
                 with _state_lock:
                     _inflight.pop(key, None)
                 my_event.set()
-            stats["unsupported"] += 1
+            _tel.inc("unsupported")
             return None
         flat = _flatten_tables(scans)
         if entry is None:
             degrade = None
             try:
-                attempt = 0
-                while True:  # in-rung transient retries (resilience.LADDER)
-                    try:
-                        _faults.maybe_fail("compile")
-                        entry = _build(plan, context, scans, caps, key,
-                                       origin=query_fp)
-                        outs = entry.fn(*flat)  # first call traces+compiles
-                        break
-                    except Unsupported as e:
-                        logger.debug("not compilable at trace time: %s", e)
-                        with _state_lock:
-                            _cache[key] = _UNSUPPORTED
-                        stats["unsupported"] += 1
-                        return None
-                    except (KeyboardInterrupt, SystemExit):
-                        raise
-                    except Exception as e:
-                        # trace-time concretization errors (host-bound
-                        # kernels) and backend compile failures both land
-                        # here, CLASSIFIED (runtime/resilience.py): a
-                        # transient (tunnel drop, device OOM, injected
-                        # fault) retries in-rung with backoff; anything
-                        # else — and exhausted retries — walks the declared
-                        # degradation ladder one rung down
-                        err = _res.classify(e)
-                        if err is None:
+                with _tel.span("compile"):
+                    attempt = 0
+                    while True:  # in-rung transient retries (resilience.LADDER)
+                        try:
+                            _faults.maybe_fail("compile")
+                            entry = _build(plan, context, scans, caps, key,
+                                           origin=query_fp)
+                            outs = entry.fn(*flat)  # first call traces+compiles
+                            break
+                        except Unsupported as e:
+                            logger.debug("not compilable at trace time: %s", e)
+                            with _state_lock:
+                                _cache[key] = _UNSUPPORTED
+                            _tel.inc("unsupported")
+                            return None
+                        except (KeyboardInterrupt, SystemExit):
                             raise
-                        if isinstance(err, (_res.DeadlineExceeded,
-                                            _res.QueryCancelled)):
-                            raise err if err is e else err from e
-                        stats["compile_errors"] += 1
-                        attempt += 1
-                        if (isinstance(err, _res.TransientError)
-                                and attempt <= _res.retry_max()):
-                            stats["retries"] += 1
-                            logger.warning(
-                                "transient compile failure (%s); retry "
-                                "%d/%d", str(err)[:200], attempt,
-                                _res.retry_max())
-                            _res.backoff(attempt, "compile")
-                            continue
-                        # degrade OUTSIDE this try: the whole→stages rung
-                        # re-enters try_execute_compiled, which must not
-                        # find this key still in _inflight and wait on
-                        # its own verdict
-                        degrade = (e, err)
-                        break
+                        except Exception as e:
+                            # trace-time concretization errors (host-bound
+                            # kernels) and backend compile failures both land
+                            # here, CLASSIFIED (runtime/resilience.py): a
+                            # transient (tunnel drop, device OOM, injected
+                            # fault) retries in-rung with backoff; anything
+                            # else — and exhausted retries — walks the declared
+                            # degradation ladder one rung down
+                            err = _res.classify(e)
+                            if err is None:
+                                raise
+                            if isinstance(err, (_res.DeadlineExceeded,
+                                                _res.QueryCancelled)):
+                                raise err if err is e else err from e
+                            _tel.inc("compile_errors")
+                            attempt += 1
+                            # retry annotation on the compile span itself:
+                            # a report showing compile=120s attempts=3
+                            # names its own bottleneck
+                            _tel.annotate(attempts=attempt)
+                            if (isinstance(err, _res.TransientError)
+                                    and attempt <= _res.retry_max()):
+                                _tel.inc("retries")
+                                logger.warning(
+                                    "transient compile failure (%s); retry "
+                                    "%d/%d", str(err)[:200], attempt,
+                                    _res.retry_max())
+                                _res.backoff(attempt, "compile")
+                                continue
+                            # degrade OUTSIDE this try: the whole→stages rung
+                            # re-enters try_execute_compiled, which must not
+                            # find this key still in _inflight and wait on
+                            # its own verdict
+                            degrade = (e, err)
+                            break
                 if degrade is None:
-                    stats["compiles"] += 1
+                    _tel.inc("compiles")
                     if in_stage:
-                        stats["stage_compiles"] += 1
+                        _tel.inc("stage_compiles")
                     with _state_lock:
                         while len(_cache) >= _CACHE_LIMIT:
                             _cache.popitem(last=False)
@@ -2678,47 +2743,60 @@ def _execute_single(plan: RelNode, context, query_fp: str,
                 return _degrade_compile(plan, context, base_key, key,
                                         degrade[0], degrade[1], split_limit)
         else:
-            stats["hits"] += 1
+            _tel.inc("hits")
+            _tel.annotate(cache_hit=True)
             if in_stage:
-                stats["stage_hits"] += 1
+                _tel.inc("stage_hits")
             if entry.origin is not None and entry.origin != query_fp:
-                stats["cross_query_hits"] += 1
+                _tel.inc("cross_query_hits")
             with _state_lock:
                 _cache.move_to_end(key)
             if os.environ.get("DSQL_TIME_DEVICE"):
                 # diagnostic split of exec wall: dispatch+device compute
                 # (block_until_ready) vs host materialize/decode.  Costs
-                # one extra device sync per call, so opt-in only.
+                # one extra device sync per call, so opt-in only.  The
+                # scratchpad is THREAD-LOCAL (telemetry.exec_profile) and
+                # the result lands on the query's own span — concurrent
+                # server queries no longer clobber each other's split.
                 t0 = time.perf_counter()
                 outs = entry.fn(*flat)
                 jax.block_until_ready(outs)
                 t1 = time.perf_counter()
-                last_exec_profile["device_ms"] = (t1 - t0) * 1e3
-                last_exec_profile["materialize_t0"] = t1
+                prof = _tel.exec_profile()
+                prof["device_ms"] = (t1 - t0) * 1e3
+                prof["materialize_t0"] = t1
+                _tel.annotate(device_ms=prof["device_ms"])
             else:
                 outs = entry.fn(*flat)
         try:
             try:
-                result = _res.retry_transient(
-                    lambda: _materialize(entry, outs), site="materialize",
-                    passthrough=(_NeedsRecompile,))
+                with _tel.span("materialize"):
+                    result = _res.retry_transient(
+                        lambda: _materialize(entry, outs),
+                        site="materialize",
+                        passthrough=(_NeedsRecompile,))
             finally:
                 # pop the DSQL_TIME_DEVICE timestamp on EVERY path: a
                 # _NeedsRecompile (or transfer failure) leaking it would
                 # stamp a bogus materialize_ms onto a later untimed call
-                _mt0 = last_exec_profile.pop("materialize_t0", None)
+                prof = _tel.exec_profile()
+                _mt0 = prof.pop("materialize_t0", None)
                 if _mt0 is not None:
-                    last_exec_profile["materialize_ms"] = \
+                    # the "materialize" span above already carries this
+                    # wall; the scratchpad copy only serves the deprecated
+                    # last_exec_profile read surface
+                    prof["materialize_ms"] = \
                         (time.perf_counter() - _mt0) * 1e3
         except _NeedsRecompile as r:
-            stats["recompiles"] += 1
+            _tel.inc("recompiles")
             caps = r.caps
             _learned_caps_put(base_key, caps)
             continue
         except _res.TransientError as e:
             # host decode failed even after retries: one rung down — the
             # eager executor recomputes from the source tables
-            stats["degradations"] += 1
+            _tel.inc("degradations")
+            _tel.annotate(degraded_to="eager")
             if os.environ.get("DSQL_EAGER_FALLBACK", "1") == "0":
                 raise
             logger.warning("materialize failed (%s); using eager executor",

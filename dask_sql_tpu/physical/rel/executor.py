@@ -45,10 +45,20 @@ class RelExecutor(Pluggable):
         # per-node deadline/cancel checkpoint: the eager path is the
         # ladder's last compute rung, and a query must not run past its
         # budget there either (runtime/resilience.py; no-op outside a scope)
-        from ...runtime import resilience as _res
+        from ...runtime import resilience as _res, telemetry as _tel
         _res.check("eager")
         plugin = RelExecutor.get_plugin(type(rel).__name__)
         logger.debug("Executing %s", rel.node_name())
+        rec = _tel.active_node_recorder()
+        if rec is not None:
+            # EXPLAIN ANALYZE instrumentation: per-node wall (inclusive of
+            # children — the renderer derives self-time) + output rows
+            import time as _time
+            t0 = _time.perf_counter()
+            result = plugin(rel, self)
+            rec.add(rel, (_time.perf_counter() - t0) * 1e3,
+                    int(getattr(result, "num_rows", 0) or 0))
+            return result
         result = plugin(rel, self)
         return result
 

@@ -65,7 +65,8 @@ from ..plan.nodes import (
     LogicalProject, LogicalSort, LogicalTableScan, LogicalWindow, RelNode,
     RexCall, RexInputRef,
 )
-from ..runtime import faults as _faults, resilience as _res
+from ..runtime import (faults as _faults, resilience as _res,
+                       telemetry as _tel)
 from ..table import Table
 from ..types import BIGINT, DOUBLE
 
@@ -544,15 +545,20 @@ def _run_batches(partial_plan: RelNode, source, context,
         # per-batch checkpoint: a cancelled/over-deadline query must stop
         # between batches, not grind through the remaining uploads
         _res.check("stream_batch")
-        table, row_valid = _res.retry_transient(
-            lambda: source.batch_table(bi), site="chunked_read")
-        _set_batch_entry(context, table, row_valid)
-        result = try_execute_compiled(partial_plan, context)
-        if result is None:
-            result = RelExecutor(context).execute(partial_plan)
-        # fetch the (small, post-aggregate) partial to host NOW: at most one
-        # batch stays resident on device — the whole point of streaming
-        acc.append(_host_partial(result))
+        with _tel.span("stream_batch", index=bi):
+            table, row_valid = _res.retry_transient(
+                lambda: source.batch_table(bi), site="chunked_read")
+            _tel.inc("stream_batches")
+            _tel.inc("stream_batch_rows", table.num_rows)
+            _set_batch_entry(context, table, row_valid)
+            result = try_execute_compiled(partial_plan, context)
+            if result is None:
+                result = RelExecutor(context).execute(partial_plan)
+            # fetch the (small, post-aggregate) partial to host NOW: at
+            # most one batch stays resident on device — the whole point of
+            # streaming
+            acc.append(_host_partial(result))
+            _tel.annotate(partial_rows=result.num_rows)
         if dedup_each_batch and len(acc) > 1:
             names, cols = _dedup_host(*_concat_host(acc))
             acc = [(names, cols)]
@@ -772,9 +778,11 @@ def _stream_window_split(win: LogicalWindow, scan, path, source, context):
         # presence, so the one full (pad==0) bucket would otherwise trace
         # a second program — a second multi-minute compile over the tunnel
         row_valid = jnp.arange(cap) < len(sel)
-        _set_batch_entry(context, btable, row_valid)
-        result = _run_resident(win_plan, context)
-        out_parts.append(_host_partial(result))
+        with _tel.span("stream_batch", bucket_rows=len(sel)):
+            _set_batch_entry(context, btable, row_valid)
+            result = _run_resident(win_plan, context)
+            _tel.inc("stream_batches")
+            out_parts.append(_host_partial(result))
         logger.debug("window bucket -> %d rows", result.num_rows)
 
     out_names, out_cols = _concat_host(out_parts)

@@ -154,10 +154,19 @@ class RelNode:
     def node_name(self) -> str:
         return type(self).__name__
 
-    def explain(self, indent: int = 0) -> str:
-        lines = [("  " * indent) + self._explain_line()]
+    def explain(self, indent: int = 0, annotate=None) -> str:
+        """Indented plan tree.  ``annotate``, when given, is a callback
+        ``node -> str`` whose non-empty return is appended to that node's
+        line — EXPLAIN ANALYZE uses it to attach measured wall-time and
+        row counts without the tree renderer knowing about telemetry."""
+        line = ("  " * indent) + self._explain_line()
+        if annotate is not None:
+            suffix = annotate(self)
+            if suffix:
+                line += " " + suffix
+        lines = [line]
         for child in self.inputs:
-            lines.append(child.explain(indent + 1))
+            lines.append(child.explain(indent + 1, annotate))
         return "\n".join(lines)
 
     def _explain_line(self) -> str:

@@ -5,5 +5,7 @@
                   the compile/execute/serve layers share.
 ``faults``      — deterministic named-site fault injection so every rung of
                   the ladder is exercised in CI, not only in production.
+``telemetry``   — per-query span tracer, the process metrics registry
+                  (counters + bounded histograms), and QueryReports.
 """
-from . import faults, resilience  # noqa: F401
+from . import faults, resilience, telemetry  # noqa: F401

@@ -259,10 +259,10 @@ def query_scope(timeout_s: Optional[float] = None,
 
 
 def _bump(key: str, n: int = 1) -> None:
-    # lazy import: compiled.py owns the canonical stats dict and imports
-    # this module at its own top level
-    from ..physical.compiled import stats
-    stats[key] = stats.get(key, 0) + n
+    # counters live in the telemetry registry (runtime/telemetry.py);
+    # ``physical.compiled.stats`` is a deprecated read-through alias of it
+    from . import telemetry as _tel
+    _tel.inc(key, n)
 
 
 def check(site: str = "") -> None:

@@ -1,0 +1,618 @@
+"""Query-lifecycle telemetry: spans, a metrics registry, and QueryReports.
+
+A compiled-query engine lives or dies by visibility into where wall time
+goes — parse vs plan vs (the dominant, 40-200 s over a tunneled TPU)
+compile vs device execute vs host materialize.  Flare (PAPERS.md) makes the
+same argument for Spark native compilation.  Before this module that
+visibility was scattered and partly broken: a module-global ``stats`` dict
+in physical/compiled.py with unlocked ``+= 1`` read-modify-writes, a
+process-global ``last_exec_profile`` that concurrent server queries
+clobbered, and ad-hoc counters in server/app.py.  Everything now funnels
+through here:
+
+**Span tracer.**  ``trace_scope(sql)`` opens a per-query trace (the same
+thread-local propagation pattern as ``resilience.QueryRuntime``; worker
+threads re-enter via ``scoped``, exactly like ``resilience.scoped``).
+``span(name)`` nests timed spans under the current one; ``annotate``
+attaches attributes (row/byte counts, cache hit/miss, degradation rung,
+retry counts) to the innermost open span.  Spans record wall time, the
+owning thread, and exceptions; child append is lock-protected because
+stage-graph workers attach concurrently.
+
+**Metrics registry.**  ``REGISTRY`` holds process-global thread-safe
+counters and bounded histograms.  It absorbs and deprecates the old
+``physical.compiled.stats`` dict (kept as a read-through alias) and the
+resilience ``_bump`` path — every increment is atomic under one lock.
+
+**Metric-name stability contract.**  The counter keys in
+``STABLE_COUNTERS`` and the histogram names in ``STABLE_HISTOGRAMS`` are a
+public, append-only interface: dashboards, ``GET /metrics`` scrapers and
+the BENCH_r*.json trajectory all key on them.  Renaming or repurposing one
+is a breaking change; add new names instead, and never reuse a retired
+name for a different meaning.  Prometheus names derive mechanically:
+counter ``k`` exports as ``dsql_<k>_total``, histogram ``h`` as
+``dsql_<h>`` with ``_bucket``/``_sum``/``_count`` series.
+
+**QueryReport.**  Closing a trace builds a ``QueryReport``: phase timings
+aggregated from the span tree, process counter deltas, row/byte counts,
+and the tree itself.  ``Context.sql`` stashes it on ``context.last_report``
+and (thread-locally) for the server's per-query wire stats.  Reports
+render as text (``render()``) or export as ``chrome://tracing`` JSON
+(``to_chrome_trace()``; ``DSQL_CHROME_TRACE_DIR`` writes one file per
+query).  ``DSQL_SLOW_QUERY_MS`` arms an opt-in slow-query log at trace
+close.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------------
+# stable metric names (see the module docstring's stability contract)
+# ---------------------------------------------------------------------------
+
+# compile/execute pipeline counters (the old physical.compiled.stats keys,
+# meanings unchanged) + streaming + server counters
+STABLE_COUNTERS: Tuple[str, ...] = (
+    # compiled pipeline
+    "compiles", "hits", "fallbacks", "unsupported", "recompiles",
+    "compile_errors", "exiled", "split_hints",
+    # stage-graph observability
+    "stage_graphs", "stage_compiles", "stage_hits", "cross_query_hits",
+    # resilience observability
+    "retries", "degradations", "deadline_exceeded",
+    "fault_compile", "fault_materialize", "fault_stage_exec",
+    "fault_chunked_read", "fault_host_transfer",
+    # streaming (out-of-HBM) execution
+    "stream_batches", "stream_batch_rows",
+    # query lifecycle
+    "queries", "query_errors", "slow_queries",
+    # server boundary
+    "server_queries", "server_query_errors", "server_cancels",
+)
+
+STABLE_HISTOGRAMS: Tuple[str, ...] = (
+    "query_wall_ms", "parse_ms", "plan_ms", "execute_ms", "compile_ms",
+    "materialize_ms",
+)
+
+# exponential-ish bucket bounds in milliseconds; histograms are BOUNDED by
+# construction (fixed bucket count + running sum/count, O(1) per observe)
+_BUCKETS_MS: Tuple[float, ...] = (
+    1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000, 30000,
+    120000,
+)
+
+
+class _Histogram:
+    __slots__ = ("counts", "total", "count")
+
+    def __init__(self):
+        self.counts = [0] * (len(_BUCKETS_MS) + 1)
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        i = 0
+        for i, b in enumerate(_BUCKETS_MS):
+            if value <= b:
+                break
+        else:
+            i = len(_BUCKETS_MS)
+        self.counts[i] += 1
+        self.total += value
+        self.count += 1
+
+    def snapshot(self) -> dict:
+        return {"buckets": list(zip(_BUCKETS_MS, self.counts)),
+                "overflow": self.counts[-1],
+                "sum": self.total, "count": self.count}
+
+
+class MetricsRegistry:
+    """Process-global thread-safe counters + bounded histograms.
+
+    ``inc`` is the atomic replacement for every unlocked
+    ``stats["k"] += 1`` read-modify-write the engine used to do; ``set``
+    exists only for the deprecated dict-alias write path.  Counter names
+    in STABLE_COUNTERS pre-exist at zero so snapshot consumers (bench
+    deltas, fault_smoke) never KeyError on a counter that has not fired.
+    """
+
+    def __init__(self, seed: Tuple[str, ...] = ()):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {k: 0 for k in seed}
+        self._hists: Dict[str, _Histogram] = {}
+
+    # -- counters ----------------------------------------------------------
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def set(self, name: str, value: int) -> None:
+        with self._lock:
+            self._counters[name] = int(value)
+
+    def get(self, name: str, default: Optional[int] = None) -> Optional[int]:
+        with self._lock:
+            return self._counters.get(name, default)
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    # -- histograms --------------------------------------------------------
+    def observe(self, name: str, value_ms: float) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _Histogram()
+            h.observe(float(value_ms))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"counters": dict(self._counters),
+                    "histograms": {k: h.snapshot()
+                                   for k, h in self._hists.items()}}
+
+    def reset(self) -> None:
+        """Zero everything (tests only; production counters are
+        monotonic by contract)."""
+        with self._lock:
+            for k in self._counters:
+                self._counters[k] = 0
+            self._hists.clear()
+
+    # -- prometheus --------------------------------------------------------
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (text/plain; version=0.0.4).
+
+        Counter ``k`` -> ``dsql_<k>_total``; histogram ``h`` ->
+        ``dsql_<h>`` with le-bucketed ``_bucket`` series + ``_sum`` +
+        ``_count``.  Names are sanitized to the prometheus charset.
+        """
+        def clean(name: str) -> str:
+            return "".join(c if (c.isalnum() or c == "_") else "_"
+                           for c in name)
+
+        snap = self.snapshot()
+        out: List[str] = []
+        for k in sorted(snap["counters"]):
+            m = f"dsql_{clean(k)}_total"
+            out.append(f"# TYPE {m} counter")
+            out.append(f"{m} {snap['counters'][k]}")
+        for k in sorted(snap["histograms"]):
+            h = snap["histograms"][k]
+            m = f"dsql_{clean(k)}"
+            out.append(f"# TYPE {m} histogram")
+            acc = 0
+            for bound, c in h["buckets"]:
+                acc += c
+                out.append(f'{m}_bucket{{le="{bound:g}"}} {acc}')
+            acc += h["overflow"]
+            out.append(f'{m}_bucket{{le="+Inf"}} {acc}')
+            out.append(f"{m}_sum {h['sum']:.6g}")
+            out.append(f"{m}_count {h['count']}")
+        return "\n".join(out) + "\n"
+
+
+REGISTRY = MetricsRegistry(seed=STABLE_COUNTERS)
+
+
+def inc(name: str, n: int = 1) -> None:
+    """Atomic counter increment on the global registry (the replacement
+    for every former ``stats[name] += 1`` site)."""
+    REGISTRY.inc(name, n)
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+class Span:
+    """One timed node of a query's span tree."""
+
+    __slots__ = ("name", "t0", "t1", "attrs", "children", "tid")
+
+    def __init__(self, name: str, attrs: Optional[dict] = None):
+        self.name = name
+        self.t0 = time.perf_counter()
+        self.t1: Optional[float] = None
+        self.attrs: Dict[str, Any] = dict(attrs) if attrs else {}
+        self.children: List["Span"] = []
+        self.tid = threading.get_ident()
+
+    @property
+    def wall_ms(self) -> float:
+        end = self.t1 if self.t1 is not None else time.perf_counter()
+        return (end - self.t0) * 1e3
+
+    def walk(self):
+        yield self
+        for c in list(self.children):
+            yield from c.walk()
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "wall_ms": round(self.wall_ms, 3),
+                "attrs": dict(self.attrs),
+                "children": [c.to_dict() for c in self.children]}
+
+
+class QueryTrace:
+    """One query's span tree + the registry snapshot at open.
+
+    ``lock`` guards child append: stage-graph worker threads attach spans
+    to the same parent concurrently."""
+
+    __slots__ = ("query", "root", "lock", "counters0", "report",
+                 "started_unix")
+
+    def __init__(self, query: str = ""):
+        self.query = query
+        self.root = Span("query")
+        self.lock = threading.Lock()
+        self.counters0 = REGISTRY.counters()
+        self.report: Optional["QueryReport"] = None
+        self.started_unix = time.time()
+
+
+class _Tls(threading.local):
+    trace: Optional[QueryTrace] = None
+    span: Optional[Span] = None
+    node_recorder = None
+    exec_profile: Optional[Dict[str, float]] = None
+    last_report: Optional["QueryReport"] = None
+
+
+_tls = _Tls()
+
+
+def current_trace() -> Optional[QueryTrace]:
+    return _tls.trace
+
+
+def current_span() -> Optional[Span]:
+    return _tls.span
+
+
+@contextmanager
+def scoped(trace: Optional[QueryTrace], parent: Optional[Span] = None):
+    """Install an existing trace in THIS thread (worker-pool re-entry —
+    the telemetry analogue of ``resilience.scoped``)."""
+    prev_t, prev_s = _tls.trace, _tls.span
+    _tls.trace = trace
+    _tls.span = parent if parent is not None else (
+        trace.root if trace is not None else None)
+    try:
+        yield
+    finally:
+        _tls.trace, _tls.span = prev_t, prev_s
+
+
+@contextmanager
+def span(name: str, **attrs):
+    """Open a child span under the current one; no-op outside a trace.
+
+    An escaping exception stamps ``error=<type name>`` on the span and
+    re-raises — the span tree always closes consistently."""
+    trace = _tls.trace
+    parent = _tls.span
+    if trace is None or parent is None:
+        yield None
+        return
+    s = Span(name, attrs)
+    with trace.lock:
+        parent.children.append(s)
+    _tls.span = s
+    try:
+        yield s
+    except BaseException as e:
+        s.attrs["error"] = type(e).__name__
+        raise
+    finally:
+        s.t1 = time.perf_counter()
+        _tls.span = parent
+
+
+def annotate(**attrs) -> None:
+    """Attach attributes to the innermost open span (no-op outside)."""
+    s = _tls.span
+    if s is not None:
+        s.attrs.update(attrs)
+
+
+# ---------------------------------------------------------------------------
+# per-thread exec profile (the last_exec_profile race fix)
+# ---------------------------------------------------------------------------
+
+def exec_profile() -> Dict[str, float]:
+    """THIS thread's device/materialize timing scratchpad.
+
+    Replaces the old process-global ``compiled.last_exec_profile`` dict,
+    which concurrent server queries clobbered; each query thread now owns
+    its own, and the authoritative copy lands on the query's span."""
+    p = _tls.exec_profile
+    if p is None:
+        p = _tls.exec_profile = {}
+    return p
+
+
+# ---------------------------------------------------------------------------
+# per-node instrumentation (EXPLAIN ANALYZE)
+# ---------------------------------------------------------------------------
+
+class NodeRecorder:
+    """Per-plan-node (wall, rows, calls) accumulator, keyed by node id.
+
+    Installed thread-locally by ``record_nodes()``; the eager executor
+    feeds it from ``RelExecutor.execute``.  Timings are INCLUSIVE of
+    children (the executor recurses through the same entry point);
+    renderers derive self-time by subtracting child totals."""
+
+    def __init__(self):
+        self.records: Dict[int, List[float]] = {}  # id -> [ms, rows, calls]
+
+    def add(self, rel, ms: float, rows: int) -> None:
+        rec = self.records.get(id(rel))
+        if rec is None:
+            self.records[id(rel)] = [ms, rows, 1]
+        else:
+            rec[0] += ms
+            rec[1] += rows
+            rec[2] += 1
+
+    def get(self, rel):
+        return self.records.get(id(rel))
+
+
+def active_node_recorder() -> Optional[NodeRecorder]:
+    return _tls.node_recorder
+
+
+@contextmanager
+def record_nodes():
+    prev = _tls.node_recorder
+    rec = NodeRecorder()
+    _tls.node_recorder = rec
+    try:
+        yield rec
+    finally:
+        _tls.node_recorder = prev
+
+
+# ---------------------------------------------------------------------------
+# reports
+# ---------------------------------------------------------------------------
+
+# span names that aggregate into the phase breakdown; "device"/"materialize"
+# values may also arrive as span ATTRS (device_ms) when DSQL_TIME_DEVICE
+# splits the execute wall
+_PHASE_SPANS = ("parse", "plan", "execute", "fetch", "compile",
+                "materialize", "stage", "stage_graph", "stream_batch")
+
+
+class QueryReport:
+    """Everything one ``Context.sql`` call did, in one object.
+
+    ``phases``: wall-ms sums per span name (parse/plan/execute/fetch at
+    the top level; compile/materialize/stage nested under execute — so
+    only parse+plan+execute+fetch partition the wall).  ``counters``:
+    process-global registry deltas between trace open and close (exact
+    per-query attribution when queries do not overlap; an upper bound
+    under concurrency).  ``root``: the span tree."""
+
+    __slots__ = ("query", "wall_ms", "phases", "counters", "root",
+                 "rows_out", "bytes_out", "started_unix")
+
+    def __init__(self, trace: QueryTrace):
+        root = trace.root
+        self.query = trace.query
+        self.started_unix = trace.started_unix
+        self.wall_ms = root.wall_ms
+        self.root = root
+        self.rows_out = int(root.attrs.get("rows_out", 0))
+        self.bytes_out = int(root.attrs.get("bytes_out", 0))
+        phases: Dict[str, float] = {}
+        for s in root.walk():
+            if s is root:
+                continue
+            if s.name in _PHASE_SPANS:
+                phases[s.name] = phases.get(s.name, 0.0) + s.wall_ms
+            for k in ("device_ms", "materialize_ms"):
+                v = s.attrs.get(k)
+                if v is not None:
+                    key = k[:-3]
+                    phases[key] = phases.get(key, 0.0) + float(v)
+        self.phases = phases
+        now = REGISTRY.counters()
+        self.counters = {k: now[k] - trace.counters0.get(k, 0)
+                         for k in now
+                         if now[k] != trace.counters0.get(k, 0)}
+
+    def span_count(self, name: str) -> int:
+        return sum(1 for s in self.root.walk() if s.name == name)
+
+    def to_dict(self) -> dict:
+        return {"query": self.query, "wall_ms": round(self.wall_ms, 3),
+                "phases": {k: round(v, 3) for k, v in self.phases.items()},
+                "counters": dict(self.counters),
+                "rows_out": self.rows_out, "bytes_out": self.bytes_out,
+                "spans": self.root.to_dict()}
+
+    def render(self) -> str:
+        """Human-readable report: header + indented span tree."""
+        lines = [f"query: {self.query.strip()[:200]}",
+                 f"wall: {self.wall_ms:.2f} ms  rows_out: {self.rows_out}"
+                 f"  bytes_out: {self.bytes_out}"]
+        if self.phases:
+            lines.append("phases: " + "  ".join(
+                f"{k}={v:.2f}ms" for k, v in sorted(self.phases.items())))
+        if self.counters:
+            lines.append("counters: " + "  ".join(
+                f"{k}=+{v}" for k, v in sorted(self.counters.items())))
+
+        def walk(s: Span, depth: int):
+            attrs = "".join(f" {k}={v}" for k, v in sorted(s.attrs.items()))
+            lines.append(f"{'  ' * depth}{s.name}: {s.wall_ms:.2f} ms"
+                         + attrs)
+            for c in s.children:
+                walk(c, depth + 1)
+
+        walk(self.root, 0)
+        return "\n".join(lines)
+
+    def to_chrome_trace(self) -> dict:
+        """chrome://tracing ("Trace Event Format") JSON of the span tree:
+        complete ("X") events in microseconds relative to the root."""
+        t0 = self.root.t0
+        events = []
+        for s in self.root.walk():
+            end = s.t1 if s.t1 is not None else time.perf_counter()
+            events.append({
+                "name": s.name, "ph": "X", "pid": os.getpid(),
+                "tid": s.tid,
+                "ts": round((s.t0 - t0) * 1e6, 1),
+                "dur": round((end - s.t0) * 1e6, 1),
+                "args": {k: (v if isinstance(v, (int, float, str, bool))
+                             else repr(v))
+                         for k, v in s.attrs.items()},
+            })
+        return {"traceEvents": events,
+                "displayTimeUnit": "ms",
+                "otherData": {"query": self.query[:500]}}
+
+
+def last_report() -> Optional[QueryReport]:
+    """The report of the most recent trace CLOSED on this thread —
+    race-free per-query attribution for the server's worker threads."""
+    return _tls.last_report
+
+
+def _env_float(name: str) -> Optional[float]:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else None
+    except ValueError:
+        return None
+
+
+_chrome_counter = [0]
+_chrome_lock = threading.Lock()
+
+
+def _close_trace(trace: QueryTrace, error: Optional[BaseException]) -> None:
+    trace.root.t1 = time.perf_counter()
+    if error is not None:
+        trace.root.attrs["error"] = type(error).__name__
+        REGISTRY.inc("query_errors")
+    report = QueryReport(trace)
+    trace.report = report
+    _tls.last_report = report
+    REGISTRY.inc("queries")
+    REGISTRY.observe("query_wall_ms", report.wall_ms)
+    for name in ("parse", "plan", "execute", "compile", "materialize"):
+        v = report.phases.get(name)
+        if v is not None:
+            REGISTRY.observe(f"{name}_ms", v)
+
+    slow_ms = _env_float("DSQL_SLOW_QUERY_MS")
+    if slow_ms is not None and report.wall_ms >= slow_ms:
+        REGISTRY.inc("slow_queries")
+        logger.warning(
+            "slow query (%.0f ms >= DSQL_SLOW_QUERY_MS=%.0f): %s | phases: "
+            "%s | counters: %s",
+            report.wall_ms, slow_ms, report.query.strip()[:500],
+            {k: round(v, 1) for k, v in sorted(report.phases.items())},
+            dict(sorted(report.counters.items())))
+
+    trace_dir = os.environ.get("DSQL_CHROME_TRACE_DIR")
+    if trace_dir:
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            with _chrome_lock:
+                _chrome_counter[0] += 1
+                n = _chrome_counter[0]
+            path = os.path.join(
+                trace_dir, f"query_{os.getpid()}_{n:05d}.trace.json")
+            with open(path, "w") as f:
+                json.dump(report.to_chrome_trace(), f)
+        except OSError as e:  # telemetry must never fail the query
+            logger.debug("chrome trace export failed: %s", e)
+
+
+@contextmanager
+def trace_scope(query: str = ""):
+    """Open the per-query trace on this thread; yields the QueryTrace.
+
+    Nested calls (a query issued from inside another query's execution)
+    yield None and ride the enclosing trace as ordinary spans — one trace
+    and one report per outermost ``Context.sql``."""
+    if _tls.trace is not None:
+        yield None
+        return
+    trace = QueryTrace(query)
+    _tls.trace = trace
+    _tls.span = trace.root
+    _tls.exec_profile = {}
+    err: Optional[BaseException] = None
+    try:
+        yield trace
+    except BaseException as e:
+        err = e
+        raise
+    finally:
+        _tls.trace = None
+        _tls.span = None
+        try:
+            _close_trace(trace, err)
+        except Exception:  # pragma: no cover - never mask the query result
+            logger.exception("telemetry close failed")
+
+
+# ---------------------------------------------------------------------------
+# deprecated dict alias support (physical.compiled.stats)
+# ---------------------------------------------------------------------------
+
+try:
+    from collections.abc import MutableMapping as _MutableMapping
+except ImportError:  # pragma: no cover
+    from collections import MutableMapping as _MutableMapping  # type: ignore
+
+
+class CounterAlias(_MutableMapping):
+    """DEPRECATED dict-shaped read-through view of REGISTRY's counters.
+
+    Exists so the long-standing ``physical.compiled.stats`` surface keeps
+    working (tests, fault_smoke, bench all read it, and ``dict(stats)``
+    must keep snapshotting every counter).  Writes forward to the registry
+    atomically — but note ``alias[k] += 1`` is still a two-step
+    read-modify-write at the CALL SITE; new code must use
+    ``telemetry.inc`` instead."""
+
+    def __getitem__(self, key: str) -> int:
+        v = REGISTRY.get(key)
+        if v is None:
+            raise KeyError(key)
+        return v
+
+    def __setitem__(self, key: str, value: int) -> None:
+        REGISTRY.set(key, value)
+
+    def __delitem__(self, key: str) -> None:
+        raise TypeError("registry counters cannot be deleted")
+
+    def __iter__(self):
+        return iter(REGISTRY.counters())
+
+    def __len__(self) -> int:
+        return len(REGISTRY.counters())
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return f"CounterAlias({REGISTRY.counters()!r})"

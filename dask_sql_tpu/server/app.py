@@ -4,7 +4,11 @@ Re-implements the reference server (/root/reference/dask_sql/server/app.py):
 ``POST /v1/statement`` submits SQL, ``GET /v1/status/{uuid}`` polls,
 ``DELETE /v1/cancel/{uuid}`` cancels, ``GET /v1/empty`` returns an empty
 result — with async execution via a thread pool + futures registry mirroring
-the reference's dask-client future_list (app.py:69-95).
+the reference's dask-client future_list (app.py:69-95).  ``GET /metrics``
+exposes the engine's telemetry registry (runtime/telemetry.py) in
+Prometheus text format — the same counters previously only reachable via
+``physical.compiled.stats`` — and per-query wire stats carry the query's
+phase breakdown from its QueryReport.
 
 Built on stdlib http.server (FastAPI/uvicorn are not in this image); the wire
 format matches the reference's responses.py so presto/trino clients work.
@@ -20,7 +24,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
-from ..runtime import resilience as _res
+from ..runtime import resilience as _res, telemetry as _tel
 
 logger = logging.getLogger(__name__)
 
@@ -56,12 +60,18 @@ def _stats(state: str, info: Optional["_QueryInfo"] = None) -> dict:
         out["peakMemoryBytes"] = info.peak_memory
         out["compiledPrograms"] = info.compiles
         out["programCacheHits"] = info.cache_hits
+        if info.phases:
+            # per-query phase breakdown from the query's own QueryReport
+            # (race-free: the report is thread-local to the worker that
+            # ran the query, not a process-global snapshot)
+            out["phaseMillis"] = {k: round(v, 3)
+                                  for k, v in info.phases.items()}
     return out
 
 
 class _QueryInfo:
     __slots__ = ("submitted", "started", "finished", "cpu_sec", "rows",
-                 "bytes", "peak_memory", "compiles", "cache_hits")
+                 "bytes", "peak_memory", "compiles", "cache_hits", "phases")
 
     def __init__(self):
         self.submitted = time.monotonic()
@@ -73,6 +83,7 @@ class _QueryInfo:
         self.peak_memory = 0
         self.compiles = 0
         self.cache_hits = 0
+        self.phases = {}
 
 
 def _run_tracked(context, sql: str, info: _QueryInfo,
@@ -97,6 +108,11 @@ def _run_tracked(context, sql: str, info: _QueryInfo,
         info.finished = time.monotonic()
         info.compiles = compiled.stats["compiles"] - c0["compiles"]
         info.cache_hits = compiled.stats["hits"] - c0["hits"]
+        # the report of the trace that just closed ON THIS THREAD — the
+        # per-query phase split concurrent queries cannot clobber
+        report = _tel.last_report()
+        if report is not None:
+            info.phases = dict(report.phases)
     if table is not None and getattr(table, "num_columns", 0):
         info.rows = table.num_rows
         info.bytes = sum(int(getattr(c.data, "nbytes", 0))
@@ -172,8 +188,20 @@ def _make_handler(state: _AppState, base_url: str):
             self.end_headers()
             self.wfile.write(body)
 
-        # GET /v1/empty  |  GET /v1/status/{uuid}
+        # GET /metrics  |  GET /v1/empty  |  GET /v1/status/{uuid}
         def do_GET(self):
+            if self.path.rstrip("/").split("?")[0] == "/metrics":
+                # Prometheus text exposition of the engine's telemetry
+                # registry: the same counters previously only reachable
+                # in-process via physical.compiled.stats
+                body = _tel.REGISTRY.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if self.path.rstrip("/") == "/v1/empty":
                 self._send(200, {
                     "id": "empty", "infoUri": base_url,
@@ -201,6 +229,7 @@ def _make_handler(state: _AppState, base_url: str):
                     del state.future_list[uid]
                     state.query_info.pop(uid, None)
                     state.cancel_events.pop(uid, None)
+                    _tel.inc("server_query_errors")
                     self._send(200, _error_payload(str(e), uid, exc=e))
                     return
                 del state.future_list[uid]
@@ -224,6 +253,7 @@ def _make_handler(state: _AppState, base_url: str):
                 return
             length = int(self.headers.get("Content-Length", 0))
             sql = self.rfile.read(length).decode()
+            _tel.inc("server_queries")
             uid = str(uuid_mod.uuid4())
             info = _QueryInfo()
             cancel = threading.Event()
@@ -257,6 +287,7 @@ def _make_handler(state: _AppState, base_url: str):
                 if cancel is not None:
                     cancel.set()
                 fut.cancel()
+                _tel.inc("server_cancels")
                 self._send(200, None)
                 return
             self._send(404, {"error": "not found"})

@@ -401,4 +401,7 @@ class DescribeTable(Statement):
 @dataclass
 class ExplainStatement(Statement):
     query: SelectLike = None
+    # EXPLAIN ANALYZE: execute the query (instrumented per plan node) and
+    # annotate the rendered tree with measured wall-time + row counts
+    analyze: bool = False
     pos: Tuple[int, int] = (0, 0)

@@ -35,6 +35,11 @@ echo "=== [2b] fault-injection smoke (resilience ladder) ==="
 # ladder must retry/degrade to the same oracle-correct answers
 DSQL_FAULT_INJECT=compile:1 python scripts/fault_smoke.py
 
+echo "=== [2c] observability smoke (telemetry layer) ==="
+# three queries with tracing armed: well-formed QueryReports, annotated
+# EXPLAIN ANALYZE, non-empty advancing /metrics, chrome-trace exports
+python scripts/obs_smoke.py
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \

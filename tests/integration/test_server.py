@@ -123,6 +123,68 @@ def test_column_shape_matches_reference(server):
                       ("h", "double", "double", [])]
 
 
+def _get_metrics(server):
+    with urllib.request.urlopen(f"{server}/metrics") as r:
+        return r.status, r.headers.get("Content-Type"), r.read().decode()
+
+
+def _metric_value(text, name):
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.rsplit(" ", 1)[1])
+    raise AssertionError(f"metric {name} not in /metrics output")
+
+
+def test_metrics_endpoint_content_type_and_counters(server):
+    """GET /metrics: prometheus text exposition of the telemetry registry
+    — the counters previously only reachable via physical.compiled.stats."""
+    status, ctype, text = _get_metrics(server)
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    assert "version=0.0.4" in ctype
+    # the stable counter names export under the dsql_ prefix
+    for name in ("dsql_compiles_total", "dsql_hits_total",
+                 "dsql_fallbacks_total", "dsql_server_queries_total",
+                 "dsql_queries_total"):
+        assert f"# TYPE {name} counter" in text
+        assert _metric_value(text, name) >= 0
+
+
+def test_metrics_counters_are_monotonic(server):
+    """Counters only move up: running a query strictly increases the
+    server-query and engine-query counters and never decreases any."""
+    _, _, before = _get_metrics(server)
+    payload = _run_to_completion(server, "SELECT COUNT(*) AS n FROM df")
+    assert payload["stats"]["state"] == "FINISHED"
+    _, _, after = _get_metrics(server)
+    assert (_metric_value(after, "dsql_server_queries_total")
+            >= _metric_value(before, "dsql_server_queries_total") + 1)
+    assert (_metric_value(after, "dsql_queries_total")
+            >= _metric_value(before, "dsql_queries_total") + 1)
+    for line in before.splitlines():
+        if line.startswith("dsql_") and "_total " in line:
+            name = line.split(" ")[0]
+            assert _metric_value(after, name) >= _metric_value(before, name)
+
+
+def test_metrics_histograms_present(server):
+    _run_to_completion(server, "SELECT 1 + 1")
+    _, _, text = _get_metrics(server)
+    assert "# TYPE dsql_query_wall_ms histogram" in text
+    assert 'dsql_query_wall_ms_bucket{le="+Inf"}' in text
+    assert _metric_value(text, "dsql_query_wall_ms_count") >= 1
+
+
+def test_stats_phase_breakdown(server):
+    """Per-query wire stats carry the query's OWN phase split (from its
+    thread-local QueryReport, not a racy process-global)."""
+    payload = _run_to_completion(server, "SELECT SUM(a) AS s FROM df")
+    phases = payload["stats"].get("phaseMillis")
+    assert phases, "phaseMillis missing from finished-query stats"
+    assert "parse" in phases and "execute" in phases
+    assert all(v >= 0 for v in phases.values())
+
+
 def test_error_location_matches_reference(server):
     """The reference asserts the exact parse position in errorLocation
     (test_server.py:60-74: 'SELECT 1 + ' -> line 1, column 10+); ours

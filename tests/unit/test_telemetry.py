@@ -1,0 +1,328 @@
+"""Unit tests for runtime/telemetry.py: span nesting + exception paths,
+registry atomicity/snapshot/reset, prometheus rendering, chrome-trace
+export, the deprecated dict aliases, and worker-thread re-entry."""
+import json
+import threading
+
+import pytest
+
+from dask_sql_tpu.runtime import telemetry as tel
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_builds_tree():
+    with tel.trace_scope("SELECT 1") as trace:
+        assert trace is not None
+        with tel.span("parse"):
+            pass
+        with tel.span("execute"):
+            with tel.span("compile"):
+                pass
+            with tel.span("materialize"):
+                pass
+    names = [s.name for s in trace.root.walk()]
+    assert names == ["query", "parse", "execute", "compile", "materialize"]
+    execute = trace.root.children[1]
+    assert [c.name for c in execute.children] == ["compile", "materialize"]
+    # every span closed with a wall time
+    for s in trace.root.walk():
+        assert s.t1 is not None
+        assert s.wall_ms >= 0.0
+
+
+def test_span_exception_path_marks_and_reraises():
+    with pytest.raises(ValueError):
+        with tel.trace_scope("boom") as trace:
+            with tel.span("execute"):
+                raise ValueError("boom")
+    # the span AND the root both closed and carry the error class
+    execute = trace.root.children[0]
+    assert execute.t1 is not None
+    assert execute.attrs["error"] == "ValueError"
+    assert trace.root.attrs["error"] == "ValueError"
+    # the report still exists for a failed query
+    assert trace.report is not None
+    assert trace.report.phases["execute"] >= 0.0
+    # and telemetry state fully unwound: no trace leaks to the next query
+    assert tel.current_trace() is None
+    assert tel.current_span() is None
+
+
+def test_span_outside_trace_is_noop():
+    assert tel.current_trace() is None
+    with tel.span("orphan") as s:
+        assert s is None
+    tel.annotate(ignored=True)  # must not raise
+
+
+def test_nested_trace_scope_rides_outer():
+    with tel.trace_scope("outer") as outer:
+        with tel.trace_scope("inner") as inner:
+            assert inner is None  # one trace per outermost query
+            with tel.span("execute"):
+                pass
+    assert outer.report.phases["execute"] >= 0.0
+
+
+def test_annotate_targets_innermost_open_span():
+    with tel.trace_scope("q") as trace:
+        with tel.span("execute"):
+            with tel.span("stage"):
+                tel.annotate(index=3, cache_hit=True)
+    stage = trace.root.children[0].children[0]
+    assert stage.attrs == {"index": 3, "cache_hit": True}
+
+
+def test_scoped_reentry_attaches_worker_spans():
+    """Worker threads re-enter the trace via scoped() (the stage-graph
+    pool pattern); their spans land under the chosen parent."""
+    with tel.trace_scope("q") as trace:
+        with tel.span("execute") as parent:
+            caught = []
+
+            def worker(i):
+                with tel.scoped(trace, parent):
+                    with tel.span("stage", index=i):
+                        caught.append(tel.current_trace() is trace)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+    assert caught == [True] * 8
+    stages = [s for s in trace.root.walk() if s.name == "stage"]
+    assert len(stages) == 8
+    assert sorted(s.attrs["index"] for s in stages) == list(range(8))
+    # concurrent child append lost nothing
+    assert trace.report.span_count("stage") == 8
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_registry_increments_are_atomic_across_threads():
+    reg = tel.MetricsRegistry()
+    N, T = 2000, 8
+
+    def bump():
+        for _ in range(N):
+            reg.inc("c")
+
+    threads = [threading.Thread(target=bump) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.get("c") == N * T
+
+
+def test_registry_snapshot_and_reset():
+    reg = tel.MetricsRegistry(seed=("a", "b"))
+    reg.inc("a", 3)
+    reg.observe("h_ms", 12.0)
+    snap = reg.snapshot()
+    assert snap["counters"] == {"a": 3, "b": 0}
+    assert snap["histograms"]["h_ms"]["count"] == 1
+    assert snap["histograms"]["h_ms"]["sum"] == 12.0
+    reg.reset()
+    snap = reg.snapshot()
+    # seeded keys survive a reset at zero; histograms clear
+    assert snap["counters"] == {"a": 0, "b": 0}
+    assert snap["histograms"] == {}
+
+
+def test_histogram_is_bounded_and_buckets_correctly():
+    reg = tel.MetricsRegistry()
+    for v in (0.5, 3.0, 3.0, 40.0, 10 ** 9):
+        reg.observe("h", v)
+    h = reg.snapshot()["histograms"]["h"]
+    assert h["count"] == 5
+    buckets = dict(h["buckets"])
+    assert buckets[1] == 1          # 0.5
+    assert buckets[5] == 2          # 3.0 x2
+    assert buckets[50] == 1         # 40.0
+    assert h["overflow"] == 1       # 1e9 beyond the last bound
+    # bounded: observing more values never grows the structure
+    assert len(h["buckets"]) == len(tel._BUCKETS_MS)
+
+
+def test_prometheus_render_shape():
+    reg = tel.MetricsRegistry(seed=("compiles",))
+    reg.inc("compiles", 2)
+    reg.observe("query_wall_ms", 7.0)
+    text = reg.render_prometheus()
+    assert "# TYPE dsql_compiles_total counter" in text
+    assert "dsql_compiles_total 2" in text
+    assert "# TYPE dsql_query_wall_ms histogram" in text
+    assert 'dsql_query_wall_ms_bucket{le="+Inf"} 1' in text
+    assert "dsql_query_wall_ms_sum 7" in text
+    assert "dsql_query_wall_ms_count 1" in text
+    # cumulative le-buckets are monotone
+    counts = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+              if line.startswith("dsql_query_wall_ms_bucket")]
+    assert counts == sorted(counts)
+
+
+def test_global_registry_seeds_stable_names():
+    counters = tel.REGISTRY.counters()
+    for name in tel.STABLE_COUNTERS:
+        assert name in counters, f"stable counter {name} not seeded"
+
+
+# ---------------------------------------------------------------------------
+# deprecated aliases
+# ---------------------------------------------------------------------------
+
+def test_compiled_stats_alias_reads_registry():
+    from dask_sql_tpu.physical import compiled
+    before = compiled.stats["compiles"]
+    tel.inc("compiles")
+    try:
+        assert compiled.stats["compiles"] == before + 1
+        snap = dict(compiled.stats)
+        assert snap["compiles"] == before + 1
+        assert "stage_graphs" in snap
+        with pytest.raises(KeyError):
+            compiled.stats["no_such_counter"]
+    finally:
+        tel.REGISTRY.set("compiles", before)
+
+
+def test_exec_profile_is_thread_local():
+    tel.exec_profile().clear()
+    tel.exec_profile()["device_ms"] = 1.5
+    seen = {}
+
+    def other():
+        seen["empty"] = dict(tel.exec_profile())
+        tel.exec_profile()["device_ms"] = 99.0
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert seen["empty"] == {}          # the other thread saw ITS OWN dict
+    assert tel.exec_profile()["device_ms"] == 1.5
+    tel.exec_profile().clear()
+
+
+# ---------------------------------------------------------------------------
+# reports
+# ---------------------------------------------------------------------------
+
+def test_report_phase_aggregation_and_counters_delta():
+    with tel.trace_scope("q") as trace:
+        tel.inc("compiles")
+        with tel.span("parse"):
+            pass
+        with tel.span("execute"):
+            with tel.span("compile"):
+                pass
+    try:
+        rep = trace.report
+        assert rep is not None
+        assert set(rep.phases) >= {"parse", "execute", "compile"}
+        # phases measured from spans can never exceed the query wall
+        assert rep.phases["parse"] + rep.phases["execute"] <= rep.wall_ms
+        assert rep.counters.get("compiles") == 1
+        # the trace's own bookkeeping (queries/query_wall_ms) lands AFTER
+        # the report snapshot: the per-query delta is engine work only
+        assert "queries" not in rep.counters
+    finally:
+        tel.REGISTRY.inc("compiles", -1)
+
+
+def test_report_render_and_dict():
+    with tel.trace_scope("SELECT x FROM t") as trace:
+        with tel.span("execute"):
+            tel.annotate(cache_hit=True)
+        trace.root.attrs["rows_out"] = 7
+    rep = trace.report
+    assert rep.rows_out == 7
+    d = rep.to_dict()
+    assert d["query"] == "SELECT x FROM t"
+    assert d["spans"]["children"][0]["attrs"] == {"cache_hit": True}
+    text = rep.render()
+    assert "SELECT x FROM t" in text
+    assert "execute" in text and "cache_hit=True" in text
+
+
+def test_chrome_trace_export_shape():
+    with tel.trace_scope("q") as trace:
+        with tel.span("execute"):
+            with tel.span("stage", index=0):
+                pass
+    blob = trace.report.to_chrome_trace()
+    events = blob["traceEvents"]
+    assert [e["name"] for e in events] == ["query", "execute", "stage"]
+    for e in events:
+        assert e["ph"] == "X"
+        assert e["ts"] >= 0 and e["dur"] >= 0
+    assert events[2]["args"] == {"index": 0}
+    json.dumps(blob)  # must be JSON-serializable as-is
+
+
+def test_chrome_trace_file_export(tmp_path, monkeypatch):
+    monkeypatch.setenv("DSQL_CHROME_TRACE_DIR", str(tmp_path))
+    with tel.trace_scope("q"):
+        with tel.span("execute"):
+            pass
+    files = list(tmp_path.glob("*.trace.json"))
+    assert len(files) == 1
+    blob = json.loads(files[0].read_text())
+    assert blob["traceEvents"][0]["name"] == "query"
+
+
+def test_slow_query_log_counter(monkeypatch, caplog):
+    import logging
+    before = tel.REGISTRY.get("slow_queries")
+    monkeypatch.setenv("DSQL_SLOW_QUERY_MS", "0")
+    with caplog.at_level(logging.WARNING,
+                         logger="dask_sql_tpu.runtime.telemetry"):
+        with tel.trace_scope("SELECT slow"):
+            pass
+    assert tel.REGISTRY.get("slow_queries") == before + 1
+    assert any("slow query" in r.message for r in caplog.records)
+
+
+def test_last_report_is_thread_local():
+    with tel.trace_scope("mine"):
+        pass
+    assert tel.last_report().query == "mine"
+    seen = {}
+
+    def other():
+        with tel.trace_scope("theirs"):
+            pass
+        seen["q"] = tel.last_report().query
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert seen["q"] == "theirs"
+    assert tel.last_report().query == "mine"  # not clobbered
+
+
+# ---------------------------------------------------------------------------
+# node recorder
+# ---------------------------------------------------------------------------
+
+def test_node_recorder_accumulates_per_node():
+    class N:  # stand-in plan node
+        pass
+
+    a, b = N(), N()
+    with tel.record_nodes() as rec:
+        assert tel.active_node_recorder() is rec
+        rec.add(a, 1.0, 10)
+        rec.add(a, 2.0, 10)
+        rec.add(b, 5.0, 3)
+    assert tel.active_node_recorder() is None
+    assert rec.get(a) == [3.0, 20, 2]
+    assert rec.get(b) == [5.0, 3, 1]
+    assert rec.get(N()) is None
