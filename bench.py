@@ -150,6 +150,14 @@ def _stage_main():
     progress_path = os.environ["BENCH_PROGRESS"]
     data = _load_data(os.environ["BENCH_DATA_DIR"])
 
+    # the RESULT cache (runtime/result_cache.py) must not contaminate the
+    # cold measurement: a repeated rep would replay the materialized result
+    # in ~1 ms and the "best of REPS" would measure the cache, not the
+    # engine.  Measurement runs with it off; the warm-repeat pass below
+    # re-arms it to record hit-rate + warm latency as a SEPARATE metric.
+    cache_mb = os.environ.get("DSQL_RESULT_CACHE_MB")
+    os.environ["DSQL_RESULT_CACHE_MB"] = "0"
+
     c = Context()
     t0 = time.perf_counter()
     for name, frame in data.items():
@@ -385,6 +393,27 @@ def _stage_main():
             emit({"q": qid, "sec": round(best, 4),
                   "platform": real_platform, "quiesced": True,
                   "breakdown": bd})
+
+        # WARM-REPEAT pass: result cache armed, each measured query run
+        # twice — run 1 populates, run 2 must be a full-query hit.  The
+        # warm latency and hit verdict are journaled per query so cache
+        # hit-rate lands in the metrics JSON without ever touching the
+        # cold numbers above.
+        os.environ["DSQL_RESULT_CACHE_MB"] = cache_mb if cache_mb else "256"
+        for qid in sorted(measured):
+            if left() < 20:
+                break
+            try:
+                c.sql(QUERIES[qid], return_futures=False)  # populate
+                t0r = time.perf_counter()
+                c.sql(QUERIES[qid], return_futures=False)
+                sec = time.perf_counter() - t0r
+                rep = getattr(c, "last_report", None)
+                rc = dict(getattr(rep, "cache", None) or {})
+                emit({"warm_hit": qid, "sec": round(sec, 4),
+                      "hit": bool(rc.get("hit")), "tier": rc.get("tier")})
+            except Exception as e:
+                emit({"warm_hit_fail": qid, "error": repr(e)[:200]})
     finally:
         # stage_done must survive anything the loops above throw: it
         # carries the compile stats and memory evidence for the artifact
@@ -483,6 +512,7 @@ def main():
         times, p_times, platforms = {}, {}, set()
         warm_times, mem, cstats = {}, {}, {}
         started, warm_fails, breakdowns, quiesced = set(), {}, {}, set()
+        warm_hits = {}
         load_sec = warmup_sec = 0.0
         try:
             with open(state["progress"]) as f:
@@ -509,6 +539,10 @@ def main():
                             quiesced.add(rec["q"])
                     elif "pq" in rec:
                         p_times[rec["pq"]] = rec["sec"]
+                    elif "warm_hit" in rec:
+                        warm_hits[rec["warm_hit"]] = {
+                            "sec": rec["sec"], "hit": bool(rec.get("hit")),
+                            "tier": rec.get("tier")}
                     elif "warm_q" in rec:
                         warm_times[rec["warm_q"]] = rec["sec"]
                     elif "warm_start" in rec:
@@ -588,6 +622,14 @@ def main():
                     "pandas_geomean_sec": round(geo_p, 4),
                     "warm_or_compile_sec_per_query":
                         {str(k): warm_times[k] for k in sorted(warm_times)},
+                    # result-cache evidence from the warm-repeat pass: the
+                    # 2nd run of each query with the cache armed (cold
+                    # numbers above always run cache-off)
+                    "warm_hit_sec": {str(k): warm_hits[k]["sec"]
+                                     for k in sorted(warm_hits)},
+                    "result_cache_hit_rate": (
+                        round(sum(1 for v in warm_hits.values() if v["hit"])
+                              / len(warm_hits), 3) if warm_hits else None),
                     "gen_sec": round(state["gen_sec"], 1),
                     "load_sec": round(load_sec, 1),
                     "warmup_compile_sec": round(warmup_sec, 1),
@@ -614,6 +656,11 @@ def main():
         if not results_path and state["progress"]:
             results_path = os.path.join(
                 os.path.dirname(state["progress"]), "bench_result.json")
+        if not results_path:
+            # the metrics object must ALWAYS land in a file: r05's artifact
+            # read "parsed": null because the bare stdout line was fished
+            # out of a mangled log tail
+            results_path = os.path.join(os.getcwd(), "bench_result.json")
         if results_path:
             try:
                 tmp = f"{results_path}.tmp{os.getpid()}"

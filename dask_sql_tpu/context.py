@@ -11,6 +11,7 @@ the JPype/Calcite bridge.
 """
 from __future__ import annotations
 
+import itertools
 import logging
 from typing import Any, Callable, List, Optional, Tuple, Union
 
@@ -55,6 +56,13 @@ class Context:
         self.server = None
         self.mesh = mesh
         self._has_chunked = False
+        # catalog epochs: monotonic per-table versions bumped by every
+        # mutating path (create/drop/alter, CTAS, schema ops) — the
+        # correctness backbone of the result cache (runtime/result_cache.py):
+        # the epoch joins every cache key, so a mutated table can never
+        # serve a stale cached result
+        self._table_epochs: dict = {}
+        self._epoch_counter = itertools.count(1)
         # register default input plugins (reference context.py:113-119 order)
         for plugin in (DeviceTableInputPlugin(), PandasLikeInputPlugin(),
                        DictInputPlugin(), ArrowInputPlugin(), HiveInputPlugin(),
@@ -63,6 +71,22 @@ class Context:
         # statement plugins live in physical/rel/custom.py; import registers them
         from .physical.rel import custom  # noqa: F401
 
+    # ------------------------------------------------------------- epochs
+    def table_epoch(self, schema_name: str, table_name: str) -> int:
+        """Current catalog epoch of (schema, table); 0 = never mutated
+        since this Context was created."""
+        return self._table_epochs.get((schema_name, table_name.lower()), 0)
+
+    def bump_table_epoch(self, schema_name: str, table_name: str) -> int:
+        """Advance the table's epoch (every mutating path calls this) and
+        drop any cached results that reference it."""
+        key = (schema_name, table_name.lower())
+        epoch = next(self._epoch_counter)
+        self._table_epochs[key] = epoch
+        from .runtime import result_cache as _rc
+        _rc.get_cache().invalidate_table(schema_name, table_name.lower())
+        return epoch
+
     # ------------------------------------------------------------- schemas
     def create_schema(self, schema_name: str):
         self.schema[schema_name] = SchemaContainer(schema_name)
@@ -70,6 +94,8 @@ class Context:
     def drop_schema(self, schema_name: str):
         if schema_name == self.DEFAULT_SCHEMA_NAME:
             raise RuntimeError(f"Default schema {schema_name} cannot be deleted")
+        for table_name in list(self.schema[schema_name].tables):
+            self.bump_table_epoch(schema_name, table_name)
         del self.schema[schema_name]
         if self.schema_name == schema_name:
             self.schema_name = self.DEFAULT_SCHEMA_NAME
@@ -119,6 +145,7 @@ class Context:
                 statistics=statistics or {"row_count": source.n_rows},
                 filepath=input_table if isinstance(input_table, str) else None)
             self.schema[schema_name].tables[table_name.lower()] = entry
+            self.bump_table_epoch(schema_name, table_name)
             logger.debug("Registered chunked table %s.%s (%d rows, %d batches)",
                          schema_name, table_name, source.n_rows,
                          source.n_batches)
@@ -133,20 +160,27 @@ class Context:
                            filepath=input_table if isinstance(input_table, str) else None,
                            gpu=gpu, row_valid=row_valid)
         self.schema[schema_name].tables[table_name.lower()] = entry
+        self.bump_table_epoch(schema_name, table_name)
         logger.debug("Registered table %s.%s (%d rows)", schema_name,
                      table_name, table.num_rows)
 
     def drop_table(self, table_name: str, schema_name: Optional[str] = None):
         schema_name = schema_name or self.schema_name
         del self.schema[schema_name].tables[table_name.lower()]
+        self.bump_table_epoch(schema_name, table_name)
 
     def alter_schema(self, old_schema_name, new_schema_name):
         self.schema[new_schema_name] = self.schema.pop(old_schema_name)
+        for table_name in list(self.schema[new_schema_name].tables):
+            self.bump_table_epoch(old_schema_name, table_name)
+            self.bump_table_epoch(new_schema_name, table_name)
 
     def alter_table(self, old_table_name, new_table_name, schema_name=None):
         schema_name = schema_name or self.schema_name
         s = self.schema[schema_name]
         s.tables[new_table_name.lower()] = s.tables.pop(old_table_name.lower())
+        self.bump_table_epoch(schema_name, old_table_name)
+        self.bump_table_epoch(schema_name, new_table_name)
 
     # ------------------------------------------------------------ functions
     def register_function(self, f: Callable, name: str,
@@ -302,6 +336,7 @@ class Context:
 
     def _execute_query_plan(self, plan):
         from .physical.rel.executor import RelExecutor
+        from .runtime import result_cache as _rc, telemetry as _tel
 
         # out-of-HBM tables route through the streaming executor — the
         # resident paths below must never compute on their binding stubs.
@@ -312,13 +347,30 @@ class Context:
                                              plan_references_chunked)
             if plan_references_chunked(plan, self):
                 return execute_streaming(plan, self)
+        # result cache: an identical plan over unmutated tables (same
+        # catalog epochs + table uids) replays its materialized result and
+        # skips device execution entirely; volatile plans key to None
+        cache = _rc.get_cache()
+        ckey = _rc.plan_key(plan, self) if cache.enabled() else None
+        if ckey is not None:
+            hit = cache.get(ckey)
+            if hit is not None:
+                table, tier = hit
+                _tel.inc("result_cache_hits")
+                _tel.annotate(result_cache="hit", result_cache_tier=tier)
+                return table
+            _tel.inc("result_cache_misses")
         # whole-plan jit (one device dispatch per query); falls back to
         # the eager per-op executor for plan shapes outside its subset
         from .physical.compiled import try_execute_compiled
         result = try_execute_compiled(plan, self)
-        if result is not None:
-            return result
-        return RelExecutor(self).execute(plan)
+        if result is None:
+            result = RelExecutor(self).execute(plan)
+        # populate only on the success path: a crashed / deadline-exceeded
+        # execution raised before this line and never reaches the cache
+        if ckey is not None and result is not None and cache.put(ckey, result):
+            _tel.annotate(result_cache="store")
+        return result
 
     def _get_plan(self, query: A.SelectLike, sql: str = "") -> RelNode:
         binder = Binder(self, sql)

@@ -65,7 +65,7 @@ from ..plan.nodes import (
     RexCall, RexInputRef, RexLiteral, RexNode,
 )
 from ..runtime import (faults as _faults, resilience as _res,
-                       telemetry as _tel)
+                       result_cache as _rcache, telemetry as _tel)
 from ..table import dict_sort_order, Column, Scalar, Table
 from .rex.evaluate import evaluate_predicate, evaluate_rex
 from .stages import (StageGraph, heavy_count as _heavy_count,
@@ -2310,9 +2310,15 @@ def _stage_table_name(node: RelNode, context) -> str:
     OBJECTS, so a concurrent overwrite writes equal content and is
     harmless.  Across queries the digest is what makes shared subplans
     collide into ONE boundary name — the consumer-side half of cross-query
-    stage reuse."""
+    stage reuse (and the key of the subplan result cache).
+
+    The shape text is ``result_cache.canonical_plan``, not ``explain()``:
+    the plan renderer elides VALUES row contents and scalar-subquery
+    bodies, so two DIFFERENT subplans could share an explain() digest —
+    unacceptable for a content address results are replayed from."""
+    shape, _, _ = _rcache.canonical_plan(node, context)
     digest = hashlib.blake2s(
-        (node.explain() + "|"
+        (shape + "|"
          + ",".join(f.stype.name for f in node.schema) + "|"
          + ",".join(_scan_uids(node, context))).encode()
     ).hexdigest()[:16]
@@ -2429,8 +2435,27 @@ def _execute_stage_graph_inner(graph: StageGraph, context, query_fp: str,
                 _tel.span("stage", index=idx):
             _res.retry_transient(
                 lambda: _faults.maybe_fail("stage_exec"), site="stage_exec")
-            return _execute_single(stages[idx].plan, context, query_fp,
-                                   split_limit, in_stage=True)
+            st = stages[idx]
+            # subplan result cache: a non-root stage's boundary name is a
+            # content digest of its subtree (scan uids included), so an
+            # OVERLAPPING query sharing the subplan replays the
+            # materialized stage output and skips its device execution —
+            # data reuse on top of the program reuse the stage cache gives
+            skey = None
+            cache = _rcache.get_cache()
+            if st.scan is not None and cache.enabled():
+                skey = _rcache.stage_key(st.scan.table_name)
+                hit = cache.get(skey)
+                if hit is not None:
+                    _tel.inc("result_cache_subplan_hits")
+                    _tel.annotate(subplan_cache="hit",
+                                  result_cache_tier=hit[1])
+                    return hit[0]
+            out = _execute_single(st.plan, context, query_fp,
+                                  split_limit, in_stage=True)
+            if skey is not None and out is not None:
+                cache.put(skey, out)
+            return out
 
     def stage_error(e: Exception) -> Optional[BaseException]:
         """None => degrade the whole graph to eager; else raise this.

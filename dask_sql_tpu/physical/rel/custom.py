@@ -96,10 +96,12 @@ def _create_table_as(stmt: A.CreateTableAs, context, sql):
         # views stay lazy: re-planned/executed per query (reference
         # CREATE VIEW = lazy dask graph, create_table_as.py:30-55)
         context.schema[schema_name].tables[name] = TableEntry(plan=plan)
+        context.bump_table_epoch(schema_name, name)
         return None
     from .executor import RelExecutor
     table = RelExecutor(context).execute(plan)
     context.schema[schema_name].tables[name] = TableEntry(table=table)
+    context.bump_table_epoch(schema_name, name)
     return None
 
 
@@ -248,7 +250,21 @@ def _explain_analyze(plan, context):
     """
     import time as _time
 
-    from ...runtime import telemetry as _tel
+    from ...runtime import result_cache as _rc, telemetry as _tel
+
+    # result-cache probe BEFORE executing: the analyzed run always executes
+    # for real (per-node instrumentation is the point), but the tree should
+    # say what a plain run of this plan would have done
+    cache = _rc.get_cache()
+    ckey = _rc.plan_key(plan, context) if cache.enabled() else None
+    if not cache.enabled():
+        cache_line = "-- cache: disabled"
+    elif ckey is None:
+        cache_line = "-- cache: uncacheable (volatile or chunked plan)"
+    else:
+        tier = cache.probe(ckey)
+        cache_line = (f"-- cache: hit tier={tier}" if tier is not None
+                      else "-- cache: miss")
 
     snap0 = _tel.REGISTRY.counters()
     t0 = _time.perf_counter()
@@ -282,10 +298,16 @@ def _explain_analyze(plan, context):
         return (f"[rows={rows} time={total_ms:.3f}ms "
                 f"self={self_ms:.3f}ms{extra}]")
 
+    # the instrumented result is a valid materialization: populate so the
+    # NEXT plain run of this query hits
+    if ckey is not None and result is not None:
+        cache.put(ckey, result)
+
     lines = plan.explain(annotate=annotate).splitlines()
     rows_out = int(getattr(result, "num_rows", 0) or 0)
     lines.append(f"-- analyzed: wall={wall_ms:.3f}ms rows_out={rows_out} "
                  f"nodes={len(rec.records)}")
+    lines.append(cache_line)
     delta = {k: snap1[k] - snap0.get(k, 0) for k in snap1
              if snap1[k] != snap0.get(k, 0)}
     if delta:

@@ -85,6 +85,13 @@ class Stage:
     through (None for the root stage, whose output is the query result);
     ``deps`` are indices into ``StageGraph.stages`` of the stages whose
     outputs this stage scans.
+
+    The boundary scan's NAME is a content digest of the producing subtree
+    (canonical shape + scanned-table uids, physical/compiled.py
+    ``_stage_table_name``) and doubles as the stage output's **subplan
+    result-cache key** (runtime/result_cache.py): equal names imply equal
+    data, so an overlapping query sharing this subtree may replay the
+    materialized output instead of re-executing the stage.
     """
 
     plan: RelNode

@@ -6,6 +6,8 @@
 ``faults``      — deterministic named-site fault injection so every rung of
                   the ladder is exercised in CI, not only in production.
 ``telemetry``   — per-query span tracer, the process metrics registry
-                  (counters + bounded histograms), and QueryReports.
+                  (counters + gauges + bounded histograms), and QueryReports.
+``result_cache``— memory-governed result & subplan cache with catalog
+                  epochs (two-tier byte-accounted LRU: device → host → drop).
 """
-from . import faults, resilience, telemetry  # noqa: F401
+from . import faults, resilience, result_cache, telemetry  # noqa: F401

@@ -15,6 +15,10 @@ at the layer boundaries —
                      (io/chunked.py ChunkedSource.batch_table)
   ``host_transfer``  fetching streamed partials to host
                      (physical/streaming.py _host_partial)
+  ``cache_populate`` storing a result/subplan into the result cache
+                     (runtime/result_cache.py ResultCache.put) — population
+                     is best-effort, so a fired fault here skips the store
+                     without failing the query
 
 — each calling ``maybe_fail(site)``, a no-op unless armed.  Arm via the
 environment, ``DSQL_FAULT_INJECT="site:nth[+][:sleep=MS]"`` (comma-separated
@@ -41,7 +45,7 @@ from typing import Dict, List, Optional, Tuple
 from .resilience import TransientError, interruptible_sleep
 
 SITES = ("compile", "materialize", "stage_exec", "chunked_read",
-         "host_transfer")
+         "host_transfer", "cache_populate")
 
 
 class FaultInjected(TransientError):

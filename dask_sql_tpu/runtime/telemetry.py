@@ -69,7 +69,11 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     # resilience observability
     "retries", "degradations", "deadline_exceeded",
     "fault_compile", "fault_materialize", "fault_stage_exec",
-    "fault_chunked_read", "fault_host_transfer",
+    "fault_chunked_read", "fault_host_transfer", "fault_cache_populate",
+    # result & subplan cache (runtime/result_cache.py)
+    "result_cache_hits", "result_cache_misses", "result_cache_stores",
+    "result_cache_evictions", "result_cache_spills",
+    "result_cache_invalidations", "result_cache_subplan_hits",
     # streaming (out-of-HBM) execution
     "stream_batches", "stream_batch_rows",
     # query lifecycle
@@ -81,6 +85,11 @@ STABLE_COUNTERS: Tuple[str, ...] = (
 STABLE_HISTOGRAMS: Tuple[str, ...] = (
     "query_wall_ms", "parse_ms", "plan_ms", "execute_ms", "compile_ms",
     "materialize_ms",
+)
+
+# gauges (point-in-time values, may go down): same append-only contract
+STABLE_GAUGES: Tuple[str, ...] = (
+    "result_cache_bytes", "result_cache_host_bytes",
 )
 
 # exponential-ish bucket bounds in milliseconds; histograms are BOUNDED by
@@ -126,9 +135,11 @@ class MetricsRegistry:
     deltas, fault_smoke) never KeyError on a counter that has not fired.
     """
 
-    def __init__(self, seed: Tuple[str, ...] = ()):
+    def __init__(self, seed: Tuple[str, ...] = (),
+                 gauge_seed: Tuple[str, ...] = ()):
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {k: 0 for k in seed}
+        self._gauges: Dict[str, float] = {k: 0 for k in gauge_seed}
         self._hists: Dict[str, _Histogram] = {}
 
     # -- counters ----------------------------------------------------------
@@ -148,6 +159,21 @@ class MetricsRegistry:
         with self._lock:
             return dict(self._counters)
 
+    # -- gauges ------------------------------------------------------------
+    def set_gauge(self, name: str, value: float) -> None:
+        """Point-in-time value (cache sizes, pool depths): unlike counters
+        a gauge may go DOWN; prometheus renders it without ``_total``."""
+        with self._lock:
+            self._gauges[name] = value
+
+    def get_gauge(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
+
+    def gauges(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._gauges)
+
     # -- histograms --------------------------------------------------------
     def observe(self, name: str, value_ms: float) -> None:
         with self._lock:
@@ -159,6 +185,7 @@ class MetricsRegistry:
     def snapshot(self) -> dict:
         with self._lock:
             return {"counters": dict(self._counters),
+                    "gauges": dict(self._gauges),
                     "histograms": {k: h.snapshot()
                                    for k, h in self._hists.items()}}
 
@@ -168,6 +195,8 @@ class MetricsRegistry:
         with self._lock:
             for k in self._counters:
                 self._counters[k] = 0
+            for k in self._gauges:
+                self._gauges[k] = 0
             self._hists.clear()
 
     # -- prometheus --------------------------------------------------------
@@ -188,6 +217,10 @@ class MetricsRegistry:
             m = f"dsql_{clean(k)}_total"
             out.append(f"# TYPE {m} counter")
             out.append(f"{m} {snap['counters'][k]}")
+        for k in sorted(snap.get("gauges", ())):
+            m = f"dsql_{clean(k)}"
+            out.append(f"# TYPE {m} gauge")
+            out.append(f"{m} {snap['gauges'][k]:g}")
         for k in sorted(snap["histograms"]):
             h = snap["histograms"][k]
             m = f"dsql_{clean(k)}"
@@ -203,7 +236,7 @@ class MetricsRegistry:
         return "\n".join(out) + "\n"
 
 
-REGISTRY = MetricsRegistry(seed=STABLE_COUNTERS)
+REGISTRY = MetricsRegistry(seed=STABLE_COUNTERS, gauge_seed=STABLE_GAUGES)
 
 
 def inc(name: str, n: int = 1) -> None:
@@ -409,7 +442,7 @@ class QueryReport:
     under concurrency).  ``root``: the span tree."""
 
     __slots__ = ("query", "wall_ms", "phases", "counters", "root",
-                 "rows_out", "bytes_out", "started_unix")
+                 "rows_out", "bytes_out", "started_unix", "cache")
 
     def __init__(self, trace: QueryTrace):
         root = trace.root
@@ -435,6 +468,27 @@ class QueryReport:
         self.counters = {k: now[k] - trace.counters0.get(k, 0)
                          for k in now
                          if now[k] != trace.counters0.get(k, 0)}
+        # result-cache section: exact per-query attribution from span attrs
+        # (runtime/result_cache.py annotates the execute/stage spans), plus
+        # the current tier sizes from the gauges
+        hit = False
+        tier: Optional[str] = None
+        stored = False
+        subplan_hits = 0
+        for s in root.walk():
+            rc = s.attrs.get("result_cache")
+            if rc == "hit":
+                hit = True
+                tier = s.attrs.get("result_cache_tier", tier)
+            elif rc == "store":
+                stored = True
+            if s.attrs.get("subplan_cache") == "hit":
+                subplan_hits += 1
+        self.cache = {"hit": hit, "tier": tier, "stored": stored,
+                      "subplan_hits": subplan_hits,
+                      "bytes": int(REGISTRY.get_gauge("result_cache_bytes")),
+                      "host_bytes":
+                          int(REGISTRY.get_gauge("result_cache_host_bytes"))}
 
     def span_count(self, name: str) -> int:
         return sum(1 for s in self.root.walk() if s.name == name)
@@ -443,6 +497,7 @@ class QueryReport:
         return {"query": self.query, "wall_ms": round(self.wall_ms, 3),
                 "phases": {k: round(v, 3) for k, v in self.phases.items()},
                 "counters": dict(self.counters),
+                "cache": dict(self.cache),
                 "rows_out": self.rows_out, "bytes_out": self.bytes_out,
                 "spans": self.root.to_dict()}
 

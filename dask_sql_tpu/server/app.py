@@ -60,6 +60,13 @@ def _stats(state: str, info: Optional["_QueryInfo"] = None) -> dict:
         out["peakMemoryBytes"] = info.peak_memory
         out["compiledPrograms"] = info.compiles
         out["programCacheHits"] = info.cache_hits
+        # result-cache verdict from the query's own QueryReport (exact,
+        # span-attributed — not a process-global counter diff)
+        out["cacheHit"] = bool(info.cache_hit)
+        if info.cache_tier:
+            out["cacheTier"] = info.cache_tier
+        if info.subplan_cache_hits:
+            out["subplanCacheHits"] = info.subplan_cache_hits
         if info.phases:
             # per-query phase breakdown from the query's own QueryReport
             # (race-free: the report is thread-local to the worker that
@@ -71,7 +78,8 @@ def _stats(state: str, info: Optional["_QueryInfo"] = None) -> dict:
 
 class _QueryInfo:
     __slots__ = ("submitted", "started", "finished", "cpu_sec", "rows",
-                 "bytes", "peak_memory", "compiles", "cache_hits", "phases")
+                 "bytes", "peak_memory", "compiles", "cache_hits", "phases",
+                 "cache_hit", "cache_tier", "subplan_cache_hits")
 
     def __init__(self):
         self.submitted = time.monotonic()
@@ -84,6 +92,9 @@ class _QueryInfo:
         self.compiles = 0
         self.cache_hits = 0
         self.phases = {}
+        self.cache_hit = False
+        self.cache_tier = None
+        self.subplan_cache_hits = 0
 
 
 def _run_tracked(context, sql: str, info: _QueryInfo,
@@ -113,6 +124,10 @@ def _run_tracked(context, sql: str, info: _QueryInfo,
         report = _tel.last_report()
         if report is not None:
             info.phases = dict(report.phases)
+            cache = getattr(report, "cache", None) or {}
+            info.cache_hit = bool(cache.get("hit"))
+            info.cache_tier = cache.get("tier")
+            info.subplan_cache_hits = int(cache.get("subplan_hits", 0))
     if table is not None and getattr(table, "num_columns", 0):
         info.rows = table.num_rows
         info.bytes = sum(int(getattr(c.data, "nbytes", 0))

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Result-cache smoke gate: the cache must serve, speed up, and invalidate.
+
+Run by scripts/ci_local.sh (mirroring fault_smoke.py / obs_smoke.py):
+
+    python scripts/cache_smoke.py
+
+Asserts, against a real Context on generated data:
+
+  1. an identical repeated query is a full-query cache hit
+     (``last_report.cache["hit"]``) whose execute phase is >= 5x faster
+     than the cold run — the hit skips device execution entirely;
+  2. DDL on a referenced table (DROP + recreate with different data)
+     invalidates: the next run is a miss and returns the NEW answer;
+  3. the telemetry registry exposes the ``result_cache_*`` counters and
+     gauges on the prometheus rendering (the /metrics surface);
+  4. ``DSQL_RESULT_CACHE_MB=0`` disables the subsystem cleanly (no hit,
+     no store, held memory released).
+
+Exit 0 on success — if the cache silently rots (keys drift, epochs stop
+bumping, hits stop landing), this gate fails loudly.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["DSQL_RESULT_CACHE_MB"] = "128"
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+
+from dask_sql_tpu import Context  # noqa: E402
+from dask_sql_tpu.runtime import result_cache as rc  # noqa: E402
+from dask_sql_tpu.runtime import telemetry as tel  # noqa: E402
+
+N = 400_000
+QUERY = ("SELECT k, SUM(v) AS s, AVG(w) AS a FROM t "
+         "GROUP BY k ORDER BY k")
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _frame(seed: int) -> pd.DataFrame:
+    rng = np.random.RandomState(seed)
+    return pd.DataFrame({
+        "k": rng.randint(0, 50, N),
+        "v": rng.randint(0, 1000, N),
+        "w": rng.rand(N),
+    })
+
+
+def main() -> int:
+    rc.get_cache().clear()
+    ctx = Context()
+    ctx.create_table("t", _frame(seed=1))
+
+    # -- 1. cold run populates, warm run hits and skips execution ----------
+    cold = ctx.sql(QUERY, return_futures=False)
+    cold_rep = ctx.last_report
+    if cold_rep.cache["hit"]:
+        return fail("cold run reported a cache hit")
+    if not cold_rep.cache["stored"]:
+        return fail("cold run did not populate the cache")
+    warm = ctx.sql(QUERY, return_futures=False)
+    warm_rep = ctx.last_report
+    if not warm_rep.cache["hit"]:
+        return fail(f"warm run missed the cache: {warm_rep.cache}")
+    if not cold.equals(warm):
+        return fail("cached result differs from the computed one")
+    cold_exec = cold_rep.phases.get("execute", 0.0)
+    warm_exec = warm_rep.phases.get("execute", 1e9)
+    if warm_exec * 5 > cold_exec:
+        return fail(f"warm execute phase not >=5x faster: cold="
+                    f"{cold_exec:.2f}ms warm={warm_exec:.2f}ms")
+    print(f"ok hit: cold execute={cold_exec:.1f}ms warm={warm_exec:.2f}ms "
+          f"({cold_exec / max(warm_exec, 1e-9):.0f}x) tier="
+          f"{warm_rep.cache['tier']}")
+
+    # -- 2. DDL invalidates: DROP + recreate with DIFFERENT data -----------
+    ctx.sql("DROP TABLE t")
+    ctx.create_table("t", _frame(seed=2))
+    fresh = ctx.sql(QUERY, return_futures=False)
+    fresh_rep = ctx.last_report
+    if fresh_rep.cache["hit"]:
+        return fail("query after DROP+recreate served a stale cached result")
+    if fresh["s"].equals(cold["s"]):
+        return fail("post-DDL result equals the old data's result")
+    print("ok invalidation: post-DDL run recomputed on the new data")
+
+    # -- 3. telemetry surface ----------------------------------------------
+    text = tel.REGISTRY.render_prometheus()
+    for name in ("dsql_result_cache_hits_total",
+                 "dsql_result_cache_stores_total",
+                 "dsql_result_cache_bytes"):
+        if name not in text:
+            return fail(f"{name} missing from the prometheus rendering")
+    hits = tel.REGISTRY.get("result_cache_hits")
+    if not hits or hits < 1:
+        return fail("result_cache_hits counter did not advance")
+    print("ok telemetry: result_cache_* counters + gauges exported")
+
+    # -- 4. DSQL_RESULT_CACHE_MB=0 disables cleanly ------------------------
+    os.environ["DSQL_RESULT_CACHE_MB"] = "0"
+    try:
+        before = tel.REGISTRY.get("result_cache_stores")
+        off = ctx.sql(QUERY, return_futures=False)
+        rep = ctx.last_report
+        if rep.cache["hit"] or rep.cache["stored"]:
+            return fail(f"cache active despite MB=0: {rep.cache}")
+        if tel.REGISTRY.get("result_cache_stores") != before:
+            return fail("store landed despite MB=0")
+        if rc.get_cache().stats()["entries"]:
+            return fail("disabled cache still holds entries")
+        if not fresh.equals(off):
+            return fail("cache-off result differs")
+    finally:
+        os.environ["DSQL_RESULT_CACHE_MB"] = "128"
+    print("ok disable: MB=0 bypasses and releases the cache")
+
+    print("result-cache smoke PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

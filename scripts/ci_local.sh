@@ -40,6 +40,11 @@ echo "=== [2c] observability smoke (telemetry layer) ==="
 # EXPLAIN ANALYZE, non-empty advancing /metrics, chrome-trace exports
 python scripts/obs_smoke.py
 
+echo "=== [2d] result-cache smoke (reuse layer) ==="
+# a repeated query must hit (execute >=5x faster), DDL on a referenced
+# table must invalidate, and DSQL_RESULT_CACHE_MB=0 must disable cleanly
+python scripts/cache_smoke.py
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \

@@ -74,14 +74,28 @@ def pytest_collection_modifyitems(items):
     items.sort(key=rank)
 
 
+@pytest.fixture(autouse=True)
+def _result_cache_off(request, monkeypatch):
+    """The result cache (runtime/result_cache.py, on by default in
+    production) would serve REPEATED queries from memory — which is exactly
+    what the program-cache/resilience/telemetry suites repeat queries to
+    observe (compile counters, retry ladders, stage spans).  Tests run with
+    it off; the dedicated test_result_cache modules arm it explicitly, and
+    scripts/cache_smoke.py gates the production-default path."""
+    if "test_result_cache" not in request.module.__name__:
+        monkeypatch.setenv("DSQL_RESULT_CACHE_MB", "0")
+    yield
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bounded_executable_lifetime():
     yield
     from dask_sql_tpu.physical import compiled
-    from dask_sql_tpu.runtime import faults
+    from dask_sql_tpu.runtime import faults, result_cache
     compiled._cache.clear()
     compiled._learned_caps.clear()
     compiled._runtime_eager.clear()
+    result_cache.get_cache().clear()
     faults.reset()
     jax.clear_caches()
 

@@ -1,0 +1,210 @@
+"""Integration tests for the result & subplan cache: end-to-end hits,
+DDL invalidation (unit + server round-trip), stage-boundary subplan reuse
+across overlapping queries, fault-injected population, and the EXPLAIN
+ANALYZE / wire-stat surfaces."""
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu.runtime import faults
+from dask_sql_tpu.runtime import result_cache as rc
+from dask_sql_tpu.runtime import telemetry as tel
+
+from tests.conftest import assert_eq, needs_compiled
+
+
+@pytest.fixture(autouse=True)
+def _armed_cache(monkeypatch):
+    monkeypatch.setenv("DSQL_RESULT_CACHE_MB", "64")
+    monkeypatch.setenv("DSQL_RESULT_CACHE_HOST_MB", "64")
+    rc.get_cache().clear()
+    yield
+    rc.get_cache().clear()
+
+
+def _ctx(seed=1, n=200):
+    rng = np.random.RandomState(seed)
+    ctx = Context()
+    ctx.create_table("t", pd.DataFrame({
+        "k": rng.randint(0, 5, n), "v": rng.randint(0, 100, n)}))
+    return ctx
+
+
+Q = "SELECT k, SUM(v) AS s FROM t GROUP BY k ORDER BY k"
+
+
+def test_repeated_query_hits_and_matches(c):
+    q = "SELECT user_id, SUM(b) AS sb FROM user_table_1 GROUP BY user_id"
+    cold = c.sql(q, return_futures=False)
+    assert c.last_report.cache["hit"] is False
+    assert c.last_report.cache["stored"] is True
+    warm = c.sql(q, return_futures=False)
+    rep = c.last_report.cache
+    assert rep["hit"] is True and rep["tier"] == "device"
+    assert_eq(warm, cold)
+    # phases: a hit executes nothing — no compile/materialize spans
+    assert "compile" not in c.last_report.phases
+
+
+def test_drop_and_recreate_never_serves_stale():
+    ctx = _ctx(seed=1)
+    old = ctx.sql(Q, return_futures=False)
+    ctx.sql("DROP TABLE t")
+    rng = np.random.RandomState(99)
+    ctx.create_table("t", pd.DataFrame({
+        "k": rng.randint(0, 5, 200), "v": rng.randint(1000, 2000, 200)}))
+    new = ctx.sql(Q, return_futures=False)
+    assert ctx.last_report.cache["hit"] is False
+    assert not new["s"].equals(old["s"])
+    # and the recomputed answer is right
+    expected = (ctx.schema["root"].tables["t"].table.to_pandas()
+                .groupby("k", as_index=False)["v"].sum()
+                .rename(columns={"v": "s"}))
+    assert_eq(new, expected)
+
+
+def test_create_or_replace_table_as_invalidates():
+    ctx = _ctx(seed=1)
+    ctx.sql("CREATE TABLE d AS SELECT k, v FROM t")
+    q = "SELECT SUM(v) AS s FROM d"
+    first = ctx.sql(q, return_futures=False)
+    ctx.sql("CREATE OR REPLACE TABLE d AS SELECT k, v + 1 AS v FROM t")
+    second = ctx.sql(q, return_futures=False)
+    assert ctx.last_report.cache["hit"] is False
+    assert int(second["s"][0]) == int(first["s"][0]) + 200
+
+
+def test_volatile_query_never_cached():
+    ctx = _ctx()
+    ctx.sql("SELECT RAND() AS r FROM t", return_futures=False)
+    rep = ctx.last_report.cache
+    assert rep["hit"] is False and rep["stored"] is False
+
+
+def test_failed_population_skips_store_not_query():
+    ctx = _ctx()
+    f0 = tel.REGISTRY.get("fault_cache_populate")
+    with faults.inject("cache_populate:1"):
+        first = ctx.sql(Q, return_futures=False)       # store sabotaged
+        assert ctx.last_report.cache["stored"] is False
+        assert tel.REGISTRY.get("fault_cache_populate") == f0 + 1
+        second = ctx.sql(Q, return_futures=False)      # miss; store lands
+        assert ctx.last_report.cache["hit"] is False
+        third = ctx.sql(Q, return_futures=False)       # now a hit
+        assert ctx.last_report.cache["hit"] is True
+    assert_eq(second, first)
+    assert_eq(third, first)
+
+
+def test_deadline_exceeded_never_populates():
+    from dask_sql_tpu.runtime import resilience as res
+
+    ctx = _ctx()
+    stores0 = tel.REGISTRY.get("result_cache_stores")
+    with pytest.raises(res.DeadlineExceeded):
+        ctx.sql(Q, timeout=1e-9)
+    assert tel.REGISTRY.get("result_cache_stores") == stores0
+    # the next (unbounded) run is a miss, not a stale/partial hit
+    ctx.sql(Q, return_futures=False)
+    assert ctx.last_report.cache["hit"] is False
+
+
+@needs_compiled
+def test_subplan_reuse_across_overlapping_queries(monkeypatch):
+    """Two DIFFERENT queries sharing a join+aggregate subplan: with the
+    stage budget forced to 1 the shared subtree becomes its own stage, and
+    the second query replays its materialized output from the cache."""
+    monkeypatch.setenv("DSQL_STAGE_HEAVY", "1")
+    rng = np.random.RandomState(3)
+    ctx = Context()
+    ctx.create_table("f", pd.DataFrame({
+        "id": rng.randint(0, 50, 2000), "v": rng.randint(0, 10, 2000)}))
+    ctx.create_table("d", pd.DataFrame({
+        "id": np.arange(50), "w": rng.randint(0, 5, 50)}))
+    shared = ("(SELECT f.id AS fid, SUM(f.v + d.w) AS sv FROM f "
+              "JOIN d ON f.id = d.id GROUP BY f.id)")
+    q1 = f"SELECT * FROM {shared} x WHERE sv > 10"
+    q2 = f"SELECT * FROM {shared} x WHERE sv > 200"
+
+    ctx.sql(q1, return_futures=False)
+    sub0 = tel.REGISTRY.get("result_cache_subplan_hits")
+    got = ctx.sql(q2, return_futures=False)
+    rep = ctx.last_report.cache
+    assert tel.REGISTRY.get("result_cache_subplan_hits") > sub0
+    assert rep["subplan_hits"] >= 1
+    assert rep["hit"] is False  # different full query: data reuse, not replay
+
+    # equality against a cache-off recompute
+    monkeypatch.setenv("DSQL_RESULT_CACHE_MB", "0")
+    expected = ctx.sql(q2, return_futures=False)
+    assert_eq(got, expected, check_row_order=False)
+
+
+def test_explain_analyze_reports_cache_state():
+    ctx = _ctx()
+    out = ctx.sql("EXPLAIN ANALYZE " + Q, return_futures=False)
+    lines = list(out["PLAN"])
+    assert any(l.startswith("-- cache: miss") for l in lines)
+    # the analyzed run populated: a plain run now hits ...
+    ctx.sql(Q, return_futures=False)
+    assert ctx.last_report.cache["hit"] is True
+    # ... and a second EXPLAIN ANALYZE sees the live entry
+    out = ctx.sql("EXPLAIN ANALYZE " + Q, return_futures=False)
+    assert any(l.startswith("-- cache: hit tier=device")
+               for l in out["PLAN"])
+
+
+# ---------------------------------------------------------------------------
+# server round trip
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def served_ctx():
+    from dask_sql_tpu.server.app import run_server
+
+    ctx = _ctx(seed=7)
+    srv = run_server(context=ctx, host="127.0.0.1", port=0, blocking=False)
+    yield ctx, f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+    ctx.server = None
+
+
+def _run(server, sql, timeout=30):
+    req = urllib.request.Request(f"{server}/v1/statement",
+                                 data=sql.encode(), method="POST")
+    with urllib.request.urlopen(req) as r:
+        payload = json.loads(r.read())
+    deadline = time.time() + timeout
+    while "nextUri" in payload and time.time() < deadline:
+        time.sleep(0.05)
+        with urllib.request.urlopen(payload["nextUri"]) as r:
+            payload = json.loads(r.read())
+    return payload
+
+
+def test_server_round_trip_cache_hit_and_ddl_invalidation(served_ctx):
+    ctx, server = served_ctx
+    cold = _run(server, Q)
+    assert cold["stats"]["cacheHit"] is False
+    warm = _run(server, Q)
+    assert warm["stats"]["cacheHit"] is True
+    assert warm["stats"]["cacheTier"] == "device"
+    assert warm["data"] == cold["data"]
+    # DDL through the server: DROP + recreate with different data
+    _run(server, "DROP TABLE t")
+    rng = np.random.RandomState(8)
+    ctx.create_table("t", pd.DataFrame({
+        "k": rng.randint(0, 5, 200), "v": rng.randint(500, 600, 200)}))
+    fresh = _run(server, Q)
+    assert fresh["stats"]["cacheHit"] is False
+    assert fresh["data"] != cold["data"]
+    # /metrics exposes the cache counters + gauges
+    with urllib.request.urlopen(f"{server}/metrics") as r:
+        text = r.read().decode()
+    assert "dsql_result_cache_hits_total" in text
+    assert "# TYPE dsql_result_cache_bytes gauge" in text
