@@ -89,6 +89,12 @@ def _geomean(xs):
     return math.exp(sum(math.log(x) for x in xs) / len(xs))
 
 
+def _pctile(xs, q):
+    """Nearest-rank percentile of a non-empty list."""
+    s = sorted(xs)
+    return s[min(int(round(q / 100.0 * (len(s) - 1))), len(s) - 1)]
+
+
 def _probe_platform():
     """Decide the platform once, in the parent.  Returns "default" when the
     image's default (the tunneled TPU) initializes, else "cpu"."""
@@ -157,6 +163,12 @@ def _stage_main():
     # re-arms it to record hit-rate + warm latency as a SEPARATE metric.
     cache_mb = os.environ.get("DSQL_RESULT_CACHE_MB")
     os.environ["DSQL_RESULT_CACHE_MB"] = "0"
+    # the workload manager (runtime/scheduler.py, 4 slots by default) must
+    # not throttle the 8-thread warmup pool: a compile that takes minutes
+    # over the tunnel would blow the admission-queue timeout and lose the
+    # query.  Measurement runs with it off; the burst pass below re-arms
+    # it to record queue-time percentiles as a SEPARATE metric.
+    os.environ["DSQL_MAX_CONCURRENT_QUERIES"] = "0"
 
     c = Context()
     t0 = time.perf_counter()
@@ -414,6 +426,58 @@ def _stage_main():
                       "hit": bool(rc.get("hit")), "tier": rc.get("tier")})
             except Exception as e:
                 emit({"warm_hit_fail": qid, "error": repr(e)[:200]})
+
+        # CONCURRENT-BURST pass: the workload manager armed with 2 slots
+        # and a 4-deep queue, 8 mixed-priority threads re-running warm
+        # (already-compiled) queries at once.  Journals one record per
+        # burst query — admitted (with its measured queue time) or
+        # rejected — so admission_reject_rate and queue-time percentiles
+        # land in the metrics JSON without touching the cold numbers.
+        if measured and left() > 30:
+            os.environ["DSQL_RESULT_CACHE_MB"] = "0"
+            os.environ["DSQL_MAX_CONCURRENT_QUERIES"] = "2"
+            os.environ["DSQL_QUEUE_DEPTH"] = "4"
+            os.environ["DSQL_QUEUE_TIMEOUT_MS"] = "120000"
+            try:
+                from dask_sql_tpu.runtime import resilience as _resil
+                from dask_sql_tpu.runtime import telemetry as _tl
+                burst_qids = (sorted(measured) * 8)[:8]
+                block = threading.Barrier(len(burst_qids), timeout=60)
+                block_lock = threading.Lock()
+
+                def burst_one(slot, qid):
+                    prio = "interactive" if slot % 2 == 0 else "batch"
+                    rec = {"burst": qid, "slot": slot, "priority": prio}
+                    try:
+                        blick = time.perf_counter()
+                        block.wait()
+                        c.sql(QUERIES[qid], return_futures=False,
+                              priority=prio)
+                        rep = _tl.last_report()
+                        rec["outcome"] = "ok"
+                        rec["sec"] = round(time.perf_counter() - blick, 4)
+                        rec["queued_ms"] = round(
+                            (rep.phases.get("queued") if rep else 0) or 0,
+                            3)
+                    except _resil.AdmissionRejected as e:
+                        rec["outcome"] = "rejected"
+                        rec["error"] = repr(e)[:200]
+                    except Exception as e:
+                        rec["outcome"] = "error"
+                        rec["error"] = repr(e)[:200]
+                    with block_lock:
+                        emit(rec)
+
+                bthreads = [threading.Thread(target=burst_one, args=(s, q))
+                            for s, q in enumerate(burst_qids)]
+                for t in bthreads:
+                    t.start()
+                for t in bthreads:
+                    t.join(timeout=150)
+            except Exception as e:
+                emit({"burst_fail": True, "error": repr(e)[:200]})
+            finally:
+                os.environ["DSQL_MAX_CONCURRENT_QUERIES"] = "0"
     finally:
         # stage_done must survive anything the loops above throw: it
         # carries the compile stats and memory evidence for the artifact
@@ -513,6 +577,7 @@ def main():
         warm_times, mem, cstats = {}, {}, {}
         started, warm_fails, breakdowns, quiesced = set(), {}, {}, set()
         warm_hits = {}
+        bursts = []
         load_sec = warmup_sec = 0.0
         try:
             with open(state["progress"]) as f:
@@ -539,6 +604,8 @@ def main():
                             quiesced.add(rec["q"])
                     elif "pq" in rec:
                         p_times[rec["pq"]] = rec["sec"]
+                    elif "burst" in rec:
+                        bursts.append(rec)
                     elif "warm_hit" in rec:
                         warm_hits[rec["warm_hit"]] = {
                             "sec": rec["sec"], "hit": bool(rec.get("hit")),
@@ -592,6 +659,20 @@ def main():
                                              for k, v in p_times.items()},
                               "stages": state["stage_meta"]}}
         else:
+            ok_b = [b for b in bursts if b.get("outcome") == "ok"
+                    and b.get("queued_ms") is not None]
+            burst_queue = None
+            if ok_b:
+                q_ms = [b["queued_ms"] for b in ok_b]
+                burst_queue = {
+                    "p50": round(_pctile(q_ms, 50), 1),
+                    "p90": round(_pctile(q_ms, 90), 1),
+                    "by_class": {
+                        p: round(_pctile([b["queued_ms"] for b in ok_b
+                                          if b.get("priority") == p], 50), 1)
+                        for p in ("interactive", "batch")
+                        if any(b.get("priority") == p for b in ok_b)},
+                }
             geo_e = _geomean([times[q] for q in done])
             based = [q for q in done if q in p_times]
             geo_p = _geomean([p_times[q] for q in based]) if based else 0.0
@@ -630,6 +711,15 @@ def main():
                     "result_cache_hit_rate": (
                         round(sum(1 for v in warm_hits.values() if v["hit"])
                               / len(warm_hits), 3) if warm_hits else None),
+                    # workload-manager evidence from the concurrent-burst
+                    # pass (2-slot scheduler, 8 mixed-priority threads):
+                    # the fraction the admission controller turned away,
+                    # and queue-time percentiles for the admitted rest
+                    "admission_reject_rate": (
+                        round(sum(1 for b in bursts
+                                  if b.get("outcome") == "rejected")
+                              / len(bursts), 3) if bursts else None),
+                    "burst_queue_time_ms": burst_queue,
                     "gen_sec": round(state["gen_sec"], 1),
                     "load_sec": round(load_sec, 1),
                     "warmup_compile_sec": round(warmup_sec, 1),

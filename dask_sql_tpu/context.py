@@ -236,7 +236,8 @@ class Context:
     def sql(self, sql: str, return_futures: bool = True,
             dataframes: Optional[dict] = None, gpu: bool = False,
             config_options: Optional[dict] = None,
-            timeout: Optional[float] = None) -> Union[Table, Any]:
+            timeout: Optional[float] = None,
+            priority: Optional[str] = None) -> Union[Table, Any]:
         """Parse, plan, optimize and execute a SQL statement.
 
         Returns a device ``Table`` (``return_futures=True``, the analogue of
@@ -255,8 +256,17 @@ class Context:
         ``self.last_report``; ``DSQL_SLOW_QUERY_MS`` arms a slow-query log
         and ``DSQL_CHROME_TRACE_DIR`` exports each query's span tree as
         chrome://tracing JSON.
+
+        ``priority`` (``"interactive"`` | ``"batch"`` | ``"background"``)
+        sets the query's workload-manager class (runtime/scheduler.py):
+        under concurrency, slots are granted by deficit-weighted priority
+        with anti-starvation aging.  Defaults to ``DSQL_DEFAULT_PRIORITY``
+        (or ``interactive``); the server maps its ``X-DSQL-Priority``
+        header here.  Time spent queued counts against ``timeout`` and
+        shows up as the ``queued`` phase of the QueryReport.
         """
-        from .runtime import resilience as _res, telemetry as _tel
+        from .runtime import (resilience as _res, scheduler as _sched,
+                              telemetry as _tel)
 
         if dataframes is not None:
             for df_name, df in dataframes.items():
@@ -270,7 +280,8 @@ class Context:
         trace = None
         try:
             with _res.query_scope(timeout_s=timeout), \
-                    _tel.trace_scope(sql) as trace:
+                    _tel.trace_scope(sql) as trace, \
+                    _sched.priority_scope(priority):
                 t0 = _time.perf_counter()
                 with _tel.span("parse"):
                     stmts = parse_sql(sql)
@@ -335,6 +346,17 @@ class Context:
             return handler(stmt, self, sql)
 
     def _execute_query_plan(self, plan):
+        # every device-executing plan — server, direct sql(), streaming,
+        # CREATE MODEL's training query — passes through the workload
+        # manager first: bounded admission, priority pick, working-set
+        # reservation.  Disabled (DSQL_MAX_CONCURRENT_QUERIES=0) or nested
+        # plans pass straight through (admission yields None).
+        from .runtime import scheduler as _sched
+
+        with _sched.get_manager().admission(plan, self):
+            return self._run_query_plan(plan)
+
+    def _run_query_plan(self, plan):
         from .physical.rel.executor import RelExecutor
         from .runtime import result_cache as _rc, telemetry as _tel
 

@@ -9,5 +9,11 @@
                   (counters + gauges + bounded histograms), and QueryReports.
 ``result_cache``— memory-governed result & subplan cache with catalog
                   epochs (two-tier byte-accounted LRU: device → host → drop).
+``scheduler``   — workload manager every query passes through before
+                  execution: bounded deadline-aware admission queue,
+                  deficit-weighted priority classes with anti-starvation
+                  aging, and the shared device-bytes ledger the result
+                  cache is a tenant of.
 """
-from . import faults, resilience, result_cache, telemetry  # noqa: F401
+from . import (faults, resilience, result_cache, scheduler,  # noqa: F401
+               telemetry)

@@ -19,6 +19,12 @@ at the layer boundaries —
                      (runtime/result_cache.py ResultCache.put) — population
                      is best-effort, so a fired fault here skips the store
                      without failing the query
+  ``admission``      admitting a query through the workload manager
+                     (runtime/scheduler.py WorkloadManager.acquire) — a
+                     fired fault fails THAT query with a typed transient
+                     error before it takes a slot, proving a broken
+                     admission path degrades cleanly instead of wedging
+                     the queue or the server
 
 — each calling ``maybe_fail(site)``, a no-op unless armed.  Arm via the
 environment, ``DSQL_FAULT_INJECT="site:nth[+][:sleep=MS]"`` (comma-separated
@@ -45,7 +51,7 @@ from typing import Dict, List, Optional, Tuple
 from .resilience import TransientError, interruptible_sleep
 
 SITES = ("compile", "materialize", "stage_exec", "chunked_read",
-         "host_transfer", "cache_populate")
+         "host_transfer", "cache_populate", "admission")
 
 
 class FaultInjected(TransientError):

@@ -18,10 +18,14 @@ host-side supervision — this module is that discipline for dask_sql_tpu:
   ``FatalError``      an engine invariant broke; retrying is pointless and
                       the failure must surface (Presto ``INTERNAL_ERROR``);
 
-plus two supervision verdicts: ``DeadlineExceeded`` (the per-query budget
+plus supervision verdicts: ``DeadlineExceeded`` (the per-query budget
 ran out — Presto ``INSUFFICIENT_RESOURCES``, like Trino's
-EXCEEDED_TIME_LIMIT) and ``QueryCancelled`` (the client abandoned the
-query).  ``classify`` maps raw exceptions into the taxonomy; call sites
+EXCEEDED_TIME_LIMIT), ``QueryCancelled`` (the client abandoned the
+query), and the admission verdicts ``AdmissionRejected`` /
+``AdmissionTimeout`` raised by the workload manager
+(runtime/scheduler.py) when the system is saturated — time spent in the
+admission queue counts against the query's deadline, so a queued query
+can expire or be cancelled exactly like a running one.  ``classify`` maps raw exceptions into the taxonomy; call sites
 choose the default bucket for unrecognized types (the server boundary
 defaults to ``UserError`` to match Presto semantics; internal sites default
 to ``FatalError``).
@@ -120,6 +124,29 @@ class QueryCancelled(UserError):
     """The client abandoned the query (DELETE /v1/cancel)."""
 
     error_name = "USER_CANCELED"
+
+
+class AdmissionRejected(ResilienceError):
+    """The workload manager (runtime/scheduler.py) refused the query at
+    submit time: queue full, or the deadline would expire before a slot
+    could plausibly free.  The server surfaces this as HTTP 429 with a
+    ``Retry-After`` derived from ``retry_after_s``."""
+
+    error_type = "INSUFFICIENT_RESOURCES"
+    error_name = "QUERY_QUEUE_FULL"
+    error_code = 0x20000
+
+    def __init__(self, message: str = "", retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = max(float(retry_after_s), 0.0)
+
+
+class AdmissionTimeout(AdmissionRejected):
+    """The query waited in the admission queue past DSQL_QUEUE_TIMEOUT_MS
+    without winning a slot (queue time always counts against the query's
+    own deadline too — see scheduler.WorkloadManager.acquire)."""
+
+    error_name = "QUERY_QUEUE_TIMEOUT"
 
 
 # exception type NAMES (not imports: the parser/binder layer must stay

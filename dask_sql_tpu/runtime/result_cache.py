@@ -30,7 +30,12 @@ eviction ladder: entries live **device-resident** (tier "device") under a
 numpy** (tier "host") under ``DSQL_RESULT_CACHE_HOST_MB``; the LRU host
 entry is **dropped**.  A host hit re-uploads and re-promotes to device.
 ``DSQL_RESULT_CACHE_MB=0`` disables the subsystem (and releases anything
-held).  Current tier sizes are exported as the ``result_cache_bytes`` /
+held).  When the workload manager (runtime/scheduler.py) is active the
+cache is additionally a **tenant of the shared device-bytes ledger**: its
+effective device budget shrinks to the ledger's free headroom and admitted
+queries' reservations actively spill the device tier
+(``shrink_device_to``), so a big concurrent query displaces cached results
+instead of OOMing.  Current tier sizes are exported as the ``result_cache_bytes`` /
 ``result_cache_host_bytes`` gauges; hits/misses/stores/evictions/spills/
 invalidations are stable counters (runtime/telemetry.py contract).
 
@@ -297,15 +302,32 @@ class ResultCache:
         self.host_bytes = 0
 
     # -- config ------------------------------------------------------------
-    def device_budget(self) -> int:
+    def _base_device_budget(self) -> int:
         return int(_env_mb("DSQL_RESULT_CACHE_MB", DEFAULT_DEVICE_MB) * 2**20)
+
+    def device_budget(self) -> int:
+        """Effective device budget: the configured ceiling, shrunk to the
+        workload manager's ledger headroom when that subsystem is active —
+        the cache is a TENANT of the shared device-bytes ledger
+        (runtime/scheduler.py), so admitted queries' reservations squeeze
+        the cache before they squeeze each other.  The allowance read is
+        lock-free on the scheduler side, so calling this under the cache
+        lock cannot invert the ledger->cache lock order."""
+        base = self._base_device_budget()
+        if base <= 0:
+            return 0
+        from . import scheduler as _sched
+        allowance = _sched.get_manager().cache_allowance()
+        return base if allowance is None else min(base, allowance)
 
     def host_budget(self) -> int:
         return int(_env_mb("DSQL_RESULT_CACHE_HOST_MB",
                            DEFAULT_HOST_MB) * 2**20)
 
     def enabled(self) -> bool:
-        if self.device_budget() > 0:
+        # the BASE budget decides liveness: ledger pressure (allowance 0)
+        # must shrink the device tier, not clear the whole cache
+        if self._base_device_budget() > 0:
             return True
         if self._entries:
             self.clear()  # flipping the env off releases held memory
@@ -393,6 +415,37 @@ class ResultCache:
         if dropped:
             _tel.inc("result_cache_invalidations", dropped)
         return dropped
+
+    def shrink_device_to(self, target_bytes: int) -> int:
+        """Pressure-driven eviction callback for the workload manager's
+        memory broker: spill (or drop) device-tier LRU entries until the
+        device tier fits ``target_bytes``.  Returns the bytes freed.  The
+        entries keep their value when the host tier can hold them — a
+        large admitted query transiently displaces the cache to host
+        instead of destroying it (or OOMing the device)."""
+        target = max(int(target_bytes), 0)
+        host_budget = self.host_budget()
+        freed = 0
+        with self._lock:
+            before = self.device_bytes
+            while self.device_bytes > target:
+                victim = self._lru_of_tier("device")
+                if victim is None:  # pragma: no cover - accounting invariant
+                    break
+                if host_budget > 0 and victim.nbytes <= host_budget:
+                    self._spill(victim)
+                else:
+                    self._drop(victim)
+            # spills may now overflow the host tier; run the normal ladder
+            while self.host_bytes > host_budget:
+                victim = self._lru_of_tier("host")
+                if victim is None:  # pragma: no cover - accounting invariant
+                    break
+                self._drop(victim)
+            freed = before - self.device_bytes
+            if freed:
+                self._publish_gauges()
+        return freed
 
     def clear(self) -> None:
         with self._lock:

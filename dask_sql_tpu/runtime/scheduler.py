@@ -1,0 +1,635 @@
+"""Workload manager: admission control, priority scheduling, memory broker.
+
+The north star is an engine serving heavy concurrent traffic, yet until this
+module every query ran the moment it arrived: the Presto server funneled
+everything into a hardcoded 4-thread pool with no queue bounds, no notion of
+priority, and no coordination between a query's memory appetite and the
+device budget the result cache already accounts against.  The reference
+dask-sql delegates all of this to dask.distributed's dynamic task scheduler;
+a TPU-native engine has no task scheduler to lean on — one compiled XLA
+program per stage — so workload management must live at the host boundary,
+in the spirit of Flare's native scheduling of heterogeneous workloads and
+DrJAX's explicit resource-mapped execution (PAPERS.md).
+
+Every query — server, ``Context.sql()``, streaming — passes through the
+process-global :class:`WorkloadManager` before touching the device.  Three
+cooperating parts:
+
+**Admission controller.**  At most ``DSQL_MAX_CONCURRENT_QUERIES`` queries
+execute at once (0 disables the whole subsystem); excess queries wait in a
+bounded queue (``DSQL_QUEUE_DEPTH``).  Admission rejects *immediately* —
+typed :class:`resilience.AdmissionRejected`, surfaced by the server as HTTP
+429 + ``Retry-After`` — when the queue is full, or when the caller's
+resilience deadline would expire before a slot could plausibly free (the
+manager keeps an EWMA of slot-hold times to estimate the wait).  A wait that
+outlives ``DSQL_QUEUE_TIMEOUT_MS`` raises ``AdmissionTimeout``; queue time
+always counts against the query's deadline (the wait loop runs
+``resilience.check`` — a queued query can be cancelled or time out exactly
+like a running one).
+
+**Priority scheduler.**  Three weighted classes — ``interactive`` >
+``batch`` > ``background`` — settable per query via
+``Context.sql(..., priority=...)`` or the ``X-DSQL-Priority`` server header.
+When a slot frees, the next query is chosen by deficit-weighted round-robin:
+each non-empty class accrues credit proportional to its weight and the
+winner pays the round's full cost, so long-run service converges to the
+weight ratio while an unserved class accumulates credit until it must win
+(anti-starvation).  Waiting time adds a direct aging boost on top
+(``DSQL_QUEUE_AGING_MS`` of waiting ≈ one extra credit), so a background
+query can never be starved by a steady interactive arrival stream.
+
+**Memory broker.**  Admission reserves an estimated working set — scanned
+table bytes × per-operator multipliers (:func:`estimate_plan_bytes`) —
+against a shared device-bytes ledger (``DSQL_DEVICE_BUDGET_MB``; 0 turns
+the broker off).  The result cache is a *tenant* of this ledger: its
+effective device budget shrinks to the ledger's free headroom
+(``cache_allowance``), and reservation pressure actively spills/evicts the
+cache's device tier (``ResultCache.shrink_device_to``) before giving up —
+a large admitted query transiently shrinks the cache instead of OOMing.
+A reservation that still cannot fit leaves the query queued (over-
+reservation queues rather than crashes); estimates larger than the whole
+budget are clamped so the query can run once it is alone.
+
+Telemetry: ``sched_queue_depth`` / ``sched_running`` /
+``sched_reserved_bytes`` gauges, per-class
+``sched_admitted_*``/``sched_rejected_*``/``sched_timeout_*`` counters
+(admitted + rejected + timeout always sums to queries submitted), and a
+``queued`` span in every admitted query's QueryReport.  The ``admission``
+fault-injection site (runtime/faults.py) fires at the top of ``acquire`` so
+CI can prove a failing admission path degrades into the typed-error
+machinery instead of crashing the server.
+
+Lock order (deadlock discipline): manager condition lock > ledger lock >
+result-cache lock.  The cache never takes a manager or ledger lock — its
+tenancy reads (``cache_allowance``) are lock-free attribute reads.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from . import faults as _faults, telemetry as _tel
+from . import resilience as _res
+from .resilience import AdmissionRejected, AdmissionTimeout, _env_int
+
+logger = logging.getLogger(__name__)
+
+PRIORITIES = ("interactive", "batch", "background")
+
+# DWRR weights: long-run slot share under sustained mixed load.  interactive
+# wins ~8 of every 12 contended slots, batch ~3, background ~1 — but the
+# deficit carry + aging boost guarantee every class is eventually served.
+WEIGHTS: Dict[str, float] = {"interactive": 8.0, "batch": 3.0,
+                             "background": 1.0}
+
+DEFAULT_MAX_CONCURRENT = 4      # matches the server's historical pool width
+DEFAULT_QUEUE_DEPTH = 32
+DEFAULT_QUEUE_TIMEOUT_MS = 30_000
+DEFAULT_AGING_MS = 2_000
+DEFAULT_DEVICE_BUDGET_MB = 4_096
+
+# deficit clamp: bounds the catch-up burst a long-unserved (or long-empty)
+# class can accumulate, so one stale credit pile cannot monopolize a window
+_DEFICIT_CAP = 8.0 * sum(WEIGHTS.values())
+
+# estimator: per-operator working-set multipliers over scanned input bytes.
+# Joins/windows buffer both sides plus outputs; aggregates/sorts roughly
+# double; unlisted operators pass input bytes through.
+_OP_MULTIPLIERS = {
+    "LogicalJoin": 3.0,
+    "LogicalWindow": 3.0,
+    "LogicalAggregate": 2.0,
+    "LogicalSort": 2.0,
+    "LogicalUnion": 1.5,
+    "LogicalIntersect": 1.5,
+    "LogicalExcept": 1.5,
+}
+_MULTIPLIER_CAP = 16.0
+_MIN_ESTIMATE = 1 << 20         # every query reserves at least 1 MiB
+
+
+def normalize_priority(raw: Optional[str]) -> str:
+    """Map user/header input to a priority class; unknown values fall back
+    to the default instead of failing the query at the wire boundary."""
+    if raw:
+        p = str(raw).strip().lower()
+        if p in PRIORITIES:
+            return p
+    return default_priority()
+
+
+def default_priority() -> str:
+    import os
+
+    p = os.environ.get("DSQL_DEFAULT_PRIORITY", "").strip().lower()
+    return p if p in PRIORITIES else "interactive"
+
+
+# ---------------------------------------------------------------------------
+# working-set estimator
+# ---------------------------------------------------------------------------
+
+def _entry_bytes(entry) -> int:
+    """Resident bytes of one catalog entry; chunked (out-of-HBM) sources
+    estimate from their row count since only a binding stub is resident."""
+    chunked = getattr(entry, "chunked", None)
+    table = getattr(entry, "table", None)
+    if chunked is not None:
+        n_rows = int(getattr(chunked, "n_rows", 0))
+        n_cols = len(getattr(table, "columns", ())) or 1
+        return n_rows * n_cols * 8
+    total = 0
+    for c in getattr(table, "columns", ()):
+        total += int(getattr(c.data, "nbytes", 0))
+        if getattr(c, "mask", None) is not None:
+            total += int(getattr(c.mask, "nbytes", 0))
+    return total
+
+
+def estimate_plan_bytes(plan, context) -> int:
+    """Estimated device working set of an optimized plan: the bytes of every
+    scanned table times the product of per-operator multipliers (capped).
+    A shape heuristic, not an oracle — the broker clamps it to the budget,
+    so an overestimate delays a query rather than wedging it."""
+    scan_bytes = 0
+    mult = 1.0
+    stack = [plan]
+    while stack:
+        rel = stack.pop()
+        t = type(rel).__name__
+        if t == "LogicalTableScan":
+            schema = context.schema.get(rel.schema_name)
+            entry = (schema.tables.get(rel.table_name)
+                     if schema is not None else None)
+            if entry is not None:
+                scan_bytes += _entry_bytes(entry)
+        else:
+            mult *= _OP_MULTIPLIERS.get(t, 1.0)
+        stack.extend(getattr(rel, "inputs", ()) or ())
+    return int(scan_bytes * min(mult, _MULTIPLIER_CAP)) + _MIN_ESTIMATE
+
+
+# ---------------------------------------------------------------------------
+# memory broker
+# ---------------------------------------------------------------------------
+
+class MemoryLedger:
+    """Shared device-bytes ledger: query reservations + the result cache's
+    device tier must fit ``DSQL_DEVICE_BUDGET_MB`` together.
+
+    ``reserve`` may be called with the manager lock held; it takes the
+    ledger lock and may nest the result-cache lock (via
+    ``shrink_device_to``) — never the other way around.  ``reserved_bytes``
+    is a lock-free read so the cache's tenancy check can call it from under
+    the cache's own lock without inverting the order.
+    """
+
+    def __init__(self, cache_fn=None):
+        self._lock = threading.Lock()
+        self._reserved = 0
+        self._cache_fn = cache_fn
+
+    def _cache(self):
+        if self._cache_fn is not None:
+            return self._cache_fn()
+        from . import result_cache as _rc
+        return _rc.get_cache()
+
+    def budget(self) -> int:
+        mb = _env_int("DSQL_DEVICE_BUDGET_MB", DEFAULT_DEVICE_BUDGET_MB)
+        return max(mb, 0) * 2**20
+
+    def reserved_bytes(self) -> int:
+        return self._reserved        # lock-free: GIL-atomic int read
+
+    def reserve(self, nbytes: int) -> Optional[int]:
+        """Reserve ``nbytes`` (clamped to the budget) against the ledger.
+
+        Returns the bytes actually reserved (0 when the broker is off), or
+        None when the reservation cannot fit even after shrinking the cache
+        tenant — the caller keeps the query queued.
+        """
+        budget = self.budget()
+        if budget <= 0:
+            return 0                 # broker disabled: admission-only mode
+        n = min(max(int(nbytes), 0), budget)
+        with self._lock:
+            cache = self._cache()
+            free = budget - self._reserved - int(cache.device_bytes)
+            if free < n:
+                # pressure-driven tenant shrink: spill/evict the cache's
+                # device tier down to what this reservation leaves over
+                target = max(budget - self._reserved - n, 0)
+                cache.shrink_device_to(target)
+                free = budget - self._reserved - int(cache.device_bytes)
+            if free < n:
+                return None
+            self._reserved += n
+            return n
+
+    def release(self, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        with self._lock:
+            self._reserved = max(self._reserved - int(nbytes), 0)
+
+
+# ---------------------------------------------------------------------------
+# tickets / seats
+# ---------------------------------------------------------------------------
+
+class Ticket:
+    """One query's passage through admission: enqueue -> admit -> release."""
+
+    __slots__ = ("priority", "est_bytes", "reserved_bytes", "enqueued_at",
+                 "admitted_at", "queued_ms", "admitted", "released")
+
+    def __init__(self, priority: str, est_bytes: int, enqueued_at: float):
+        self.priority = priority
+        self.est_bytes = est_bytes
+        self.reserved_bytes = 0
+        self.enqueued_at = enqueued_at
+        self.admitted_at: Optional[float] = None
+        self.queued_ms: Optional[float] = None
+        self.admitted = False
+        self.released = False
+
+
+class Seat:
+    """A server-side pre-claim made at POST time, before a worker thread
+    picks the query up.  Counts toward the queue bound (so saturation 429s
+    immediately instead of hiding in the thread pool's unbounded backlog)
+    and carries the true enqueue timestamp, so ``queuedTimeMillis`` covers
+    pool wait + scheduler wait."""
+
+    __slots__ = ("priority", "enqueued_at", "consumed")
+
+    def __init__(self, priority: str, enqueued_at: float):
+        self.priority = priority
+        self.enqueued_at = enqueued_at
+        self.consumed = False
+
+
+class _Tls(threading.local):
+    ticket: Optional[Ticket] = None
+    seat: Optional[Seat] = None
+    priority: Optional[str] = None
+    last_queued_ms: Optional[float] = None
+
+
+_tls = _Tls()
+
+
+@contextmanager
+def priority_scope(priority: Optional[str]):
+    """Install the explicit ``Context.sql(priority=...)`` choice for this
+    thread; admission resolves explicit > seat > DSQL_DEFAULT_PRIORITY."""
+    if priority is not None and priority not in PRIORITIES:
+        raise ValueError(
+            f"unknown priority {priority!r} (expected one of {PRIORITIES})")
+    prev = _tls.priority
+    _tls.priority = priority
+    try:
+        yield
+    finally:
+        _tls.priority = prev
+
+
+@contextmanager
+def seat_scope(seat: Optional[Seat]):
+    """Install a server-claimed seat for this worker thread; the next
+    admission consumes it (timestamp + priority)."""
+    prev = _tls.seat
+    _tls.seat = seat
+    try:
+        yield
+    finally:
+        _tls.seat = prev
+
+
+def clear_thread_queued_ms() -> None:
+    _tls.last_queued_ms = None
+
+
+def thread_queued_ms() -> Optional[float]:
+    """Measured queue time of the last admission on THIS thread (from the
+    seat/enqueue timestamp to the admit timestamp) — race-free per-query
+    attribution for the server's wire stats."""
+    return _tls.last_queued_ms
+
+
+# ---------------------------------------------------------------------------
+# the workload manager
+# ---------------------------------------------------------------------------
+
+class WorkloadManager:
+    """Process-global admission controller + priority scheduler + broker."""
+
+    def __init__(self, cache_fn=None):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._running = 0
+        self._seats = 0
+        self._waiting: Dict[str, "deque[Ticket]"] = {
+            p: deque() for p in PRIORITIES}
+        self._deficit: Dict[str, float] = {p: 0.0 for p in PRIORITIES}
+        self._run_ewma_s: Optional[float] = None
+        self.ledger = MemoryLedger(cache_fn)
+
+    # -- config (env-read per call, like the result cache, so tests and
+    # -- operators can flip knobs without a restart) ------------------------
+    def limit(self) -> int:
+        return max(_env_int("DSQL_MAX_CONCURRENT_QUERIES",
+                            DEFAULT_MAX_CONCURRENT), 0)
+
+    def depth(self) -> int:
+        return max(_env_int("DSQL_QUEUE_DEPTH", DEFAULT_QUEUE_DEPTH), 0)
+
+    def queue_timeout_s(self) -> float:
+        return max(_env_int("DSQL_QUEUE_TIMEOUT_MS",
+                            DEFAULT_QUEUE_TIMEOUT_MS), 0) / 1e3
+
+    def aging_ms(self) -> float:
+        return float(max(_env_int("DSQL_QUEUE_AGING_MS", DEFAULT_AGING_MS),
+                         0))
+
+    def enabled(self) -> bool:
+        return self.limit() > 0
+
+    def cache_allowance(self) -> Optional[int]:
+        """Device bytes the result cache may hold right now under ledger
+        tenancy, or None when the subsystem/broker is off.  Lock-free —
+        called from under the cache's own lock."""
+        if not self.enabled():
+            return None
+        budget = self.ledger.budget()
+        if budget <= 0:
+            return None
+        return max(budget - self.ledger.reserved_bytes(), 0)
+
+    # -- live introspection (server wire stats) -----------------------------
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._waiting_count_locked() + self._seats
+
+    def running_count(self) -> int:
+        with self._lock:
+            return self._running
+
+    # -- seats (server POST-time pre-claims) --------------------------------
+    def claim_seat(self, priority: str) -> Optional[Seat]:
+        """Claim a place in line at submit time; raises AdmissionRejected
+        (HTTP 429 at the server) when running + queued + seats already fill
+        every slot and queue position."""
+        if not self.enabled():
+            return None
+        priority = normalize_priority(priority)
+        with self._cv:
+            limit, depth = self.limit(), self.depth()
+            outstanding = (self._running + self._waiting_count_locked()
+                           + self._seats)
+            if outstanding >= limit + depth:
+                _tel.inc(f"sched_rejected_{priority}")
+                raise AdmissionRejected(
+                    f"admission queue full ({outstanding} queries "
+                    f"outstanding >= {limit} slots + {depth} queued)",
+                    retry_after_s=self._retry_after_locked())
+            self._seats += 1
+            self._publish_locked()
+        return Seat(priority, time.monotonic())
+
+    def release_seat(self, seat: Optional[Seat]) -> None:
+        """Return an unconsumed seat (query failed before admission, or was
+        a DDL statement that never executes a plan)."""
+        if seat is None or seat.consumed:
+            return
+        with self._cv:
+            self._consume_seat_locked(seat)
+            self._publish_locked()
+
+    def _consume_seat_locked(self, seat: Seat) -> None:
+        if not seat.consumed:
+            seat.consumed = True
+            self._seats = max(self._seats - 1, 0)
+
+    # -- admission ----------------------------------------------------------
+    def acquire(self, priority: str, est_bytes: int,
+                seat: Optional[Seat] = None) -> Ticket:
+        """Block until admitted; raises the typed verdict otherwise.
+
+        The wait is deadline/cancellation-aware (``resilience.check`` runs
+        every slice, so queue time counts against the query budget), aging-
+        aware, and bounded by ``DSQL_QUEUE_TIMEOUT_MS``.  ``seat`` transfers
+        a server pre-claim: its timestamp becomes the queue-time origin.
+        """
+        _faults.maybe_fail("admission")
+        priority = normalize_priority(priority)
+        enqueued_at = seat.enqueued_at if seat is not None else \
+            time.monotonic()
+        ticket = Ticket(priority, int(est_bytes), enqueued_at)
+        with self._cv:
+            if seat is not None:
+                self._consume_seat_locked(seat)
+            limit, depth = self.limit(), self.depth()
+            n_wait = self._waiting_count_locked()
+            if self._running >= limit and n_wait >= depth:
+                _tel.inc(f"sched_rejected_{priority}")
+                self._publish_locked()
+                raise AdmissionRejected(
+                    f"admission queue full ({n_wait} waiting >= depth "
+                    f"{depth})", retry_after_s=self._retry_after_locked())
+            # deadline-aware fast reject: do not enqueue a query whose
+            # budget cannot plausibly survive the wait for a slot
+            rt = _res.current()
+            if rt is not None and self._running >= limit:
+                rem = rt.remaining()
+                expected = self._expected_wait_locked(n_wait)
+                if (rem is not None and expected is not None
+                        and rem < expected * 0.5):
+                    _tel.inc(f"sched_rejected_{priority}")
+                    self._publish_locked()
+                    raise AdmissionRejected(
+                        f"deadline would expire while queued "
+                        f"({rem * 1e3:.0f} ms left, ~{expected * 1e3:.0f} "
+                        f"ms expected wait)",
+                        retry_after_s=self._retry_after_locked())
+            self._waiting[priority].append(ticket)
+            self._publish_locked()
+            self._dispatch_locked()
+            give_up = (time.monotonic() + self.queue_timeout_s()
+                       if self.queue_timeout_s() > 0 else None)
+            try:
+                while not ticket.admitted:
+                    _res.check("admission")
+                    if give_up is not None and time.monotonic() >= give_up:
+                        raise AdmissionTimeout(
+                            f"queued {priority} query timed out after "
+                            f"{self.queue_timeout_s() * 1e3:.0f} ms",
+                            retry_after_s=self._retry_after_locked())
+                    self._cv.wait(0.05)
+            except BaseException:
+                if ticket.admitted:
+                    # admitted in the same instant the wait was abandoned:
+                    # hand the slot straight back
+                    self._release_locked(ticket)
+                else:
+                    self._abandon_locked(ticket)
+                    # any abandoned wait — queue timeout, deadline expiry,
+                    # cancellation — counts into the timeout family so
+                    # admitted + rejected + timeout == submitted, always
+                    _tel.inc(f"sched_timeout_{priority}")
+                self._publish_locked()
+                raise
+        _tls.last_queued_ms = ticket.queued_ms
+        return ticket
+
+    def release(self, ticket: Optional[Ticket]) -> None:
+        if ticket is None:
+            return
+        with self._cv:
+            self._release_locked(ticket)
+            self._publish_locked()
+
+    # -- internals (condition lock held) ------------------------------------
+    def _waiting_count_locked(self) -> int:
+        return sum(len(q) for q in self._waiting.values())
+
+    def _abandon_locked(self, ticket: Ticket) -> None:
+        try:
+            self._waiting[ticket.priority].remove(ticket)
+        except ValueError:  # pragma: no cover - double abandon
+            pass
+
+    def _expected_wait_locked(self, n_ahead: int) -> Optional[float]:
+        """Rough wait estimate: EWMA slot-hold time × queue position /
+        slots.  None until at least one query has completed (no history —
+        never reject on a guess)."""
+        if self._run_ewma_s is None:
+            return None
+        return self._run_ewma_s * (n_ahead + 1) / max(self.limit(), 1)
+
+    def _retry_after_locked(self) -> float:
+        expected = self._expected_wait_locked(self._waiting_count_locked())
+        if expected is None:
+            return 1.0
+        return min(max(math.ceil(expected), 1.0), 60.0)
+
+    def _pick_locked(self) -> Optional[str]:
+        """Deficit-weighted round-robin with aging: every non-empty class
+        gains its weight; the winner (highest deficit + aging boost) pays
+        the round's total, so service converges to the weight ratio and an
+        unserved class accumulates credit until it must win."""
+        active = [p for p in PRIORITIES if self._waiting[p]]
+        if not active:
+            return None
+        for p in active:
+            self._deficit[p] = min(self._deficit[p] + WEIGHTS[p],
+                                   _DEFICIT_CAP)
+        aging = self.aging_ms()
+        now = time.monotonic()
+
+        def score(p: str) -> float:
+            head = self._waiting[p][0]
+            waited_ms = (now - head.enqueued_at) * 1e3
+            boost = waited_ms / aging if aging > 0 else 0.0
+            return self._deficit[p] + boost
+
+        best = max(active, key=score)
+        self._deficit[best] -= sum(WEIGHTS[p] for p in active)
+        return best
+
+    def _dispatch_locked(self) -> None:
+        limit = self.limit()
+        while self._running < limit:
+            p = self._pick_locked()
+            if p is None:
+                break
+            ticket = self._waiting[p][0]
+            reserved = self.ledger.reserve(ticket.est_bytes)
+            if reserved is None:
+                # over-reservation queues rather than crashes: refund the
+                # round's deficit charge and retry at the next release
+                self._deficit[p] += sum(
+                    WEIGHTS[q] for q in PRIORITIES if self._waiting[q])
+                break
+            self._waiting[p].popleft()
+            if not self._waiting[p]:
+                self._deficit[p] = 0.0   # classic DRR: empty queue resets
+            ticket.reserved_bytes = reserved
+            ticket.admitted = True
+            ticket.admitted_at = time.monotonic()
+            ticket.queued_ms = (ticket.admitted_at
+                                - ticket.enqueued_at) * 1e3
+            self._running += 1
+            _tel.inc(f"sched_admitted_{p}")
+            self._cv.notify_all()
+        self._publish_locked()
+
+    def _release_locked(self, ticket: Ticket) -> None:
+        if ticket.released or not ticket.admitted:
+            return
+        ticket.released = True
+        self._running = max(self._running - 1, 0)
+        self.ledger.release(ticket.reserved_bytes)
+        if ticket.admitted_at is not None:
+            held = time.monotonic() - ticket.admitted_at
+            self._run_ewma_s = (held if self._run_ewma_s is None
+                                else 0.3 * held + 0.7 * self._run_ewma_s)
+        self._dispatch_locked()
+        self._cv.notify_all()
+
+    def _publish_locked(self) -> None:
+        _tel.REGISTRY.set_gauge("sched_queue_depth",
+                                self._waiting_count_locked() + self._seats)
+        _tel.REGISTRY.set_gauge("sched_running", self._running)
+        _tel.REGISTRY.set_gauge("sched_reserved_bytes",
+                                self.ledger.reserved_bytes())
+
+    # -- the one call site: Context._execute_query_plan ---------------------
+    @contextmanager
+    def admission(self, plan=None, context=None,
+                  priority: Optional[str] = None):
+        """Admit one query plan for execution: resolve priority, estimate
+        the working set, wait for a slot + memory under a ``queued`` span,
+        and release both on exit.  Yields None (pass-through) when the
+        subsystem is disabled or when this thread already holds a slot
+        (nested plans — CREATE MODEL's training query, views — ride the
+        outer admission instead of deadlocking on a second slot)."""
+        if not self.enabled() or _tls.ticket is not None:
+            yield None
+            return
+        seat, _tls.seat = _tls.seat, None      # consume the seat exactly once
+        pr = priority or _tls.priority or \
+            (seat.priority if seat is not None else None) or \
+            default_priority()
+        est = 0
+        if plan is not None and context is not None:
+            try:
+                est = estimate_plan_bytes(plan, context)
+            except Exception:      # estimator must never fail a query
+                logger.debug("working-set estimate failed", exc_info=True)
+                est = _MIN_ESTIMATE
+        with _tel.span("queued", priority=pr):
+            ticket = self.acquire(pr, est, seat=seat)
+            _tel.annotate(queued_ms=round(ticket.queued_ms or 0.0, 3),
+                          reserved_bytes=ticket.reserved_bytes)
+        _tls.ticket = ticket
+        try:
+            yield ticket
+        finally:
+            _tls.ticket = None
+            self.release(ticket)
+
+
+_MANAGER = WorkloadManager()
+
+
+def get_manager() -> WorkloadManager:
+    """The process-global workload manager (like the result cache: one
+    ledger and one queue per process, shared by every Context)."""
+    return _MANAGER

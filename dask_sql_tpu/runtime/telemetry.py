@@ -70,6 +70,16 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     "retries", "degradations", "deadline_exceeded",
     "fault_compile", "fault_materialize", "fault_stage_exec",
     "fault_chunked_read", "fault_host_transfer", "fault_cache_populate",
+    "fault_admission",
+    # workload manager (runtime/scheduler.py): per-class admission
+    # outcomes; for any submission mix, admitted + rejected + timeout
+    # always sums to the queries that entered admission
+    "sched_admitted_interactive", "sched_admitted_batch",
+    "sched_admitted_background",
+    "sched_rejected_interactive", "sched_rejected_batch",
+    "sched_rejected_background",
+    "sched_timeout_interactive", "sched_timeout_batch",
+    "sched_timeout_background",
     # result & subplan cache (runtime/result_cache.py)
     "result_cache_hits", "result_cache_misses", "result_cache_stores",
     "result_cache_evictions", "result_cache_spills",
@@ -80,6 +90,7 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     "queries", "query_errors", "slow_queries",
     # server boundary
     "server_queries", "server_query_errors", "server_cancels",
+    "server_throttled",
 )
 
 STABLE_HISTOGRAMS: Tuple[str, ...] = (
@@ -90,6 +101,9 @@ STABLE_HISTOGRAMS: Tuple[str, ...] = (
 # gauges (point-in-time values, may go down): same append-only contract
 STABLE_GAUGES: Tuple[str, ...] = (
     "result_cache_bytes", "result_cache_host_bytes",
+    # workload manager: live queue depth (incl. server seats), queries
+    # currently executing, and device bytes reserved by admitted queries
+    "sched_queue_depth", "sched_running", "sched_reserved_bytes",
 )
 
 # exponential-ish bucket bounds in milliseconds; histograms are BOUNDED by
@@ -428,7 +442,8 @@ def record_nodes():
 # values may also arrive as span ATTRS (device_ms) when DSQL_TIME_DEVICE
 # splits the execute wall
 _PHASE_SPANS = ("parse", "plan", "execute", "fetch", "compile",
-                "materialize", "stage", "stage_graph", "stream_batch")
+                "materialize", "stage", "stage_graph", "stream_batch",
+                "queued")
 
 
 class QueryReport:

@@ -4,7 +4,13 @@ Re-implements the reference server (/root/reference/dask_sql/server/app.py):
 ``POST /v1/statement`` submits SQL, ``GET /v1/status/{uuid}`` polls,
 ``DELETE /v1/cancel/{uuid}`` cancels, ``GET /v1/empty`` returns an empty
 result — with async execution via a thread pool + futures registry mirroring
-the reference's dask-client future_list (app.py:69-95).  ``GET /metrics``
+the reference's dask-client future_list (app.py:69-95).  Submission runs
+through the workload manager (runtime/scheduler.py): every POST claims an
+admission seat (priority from the ``X-DSQL-Priority`` header), a saturated
+system answers 429 + ``Retry-After`` immediately, ``queuedTimeMillis`` and
+``queuedSplits``/``runningSplits`` report the scheduler's real measurements,
+and the pool is sized by ``DSQL_SERVER_WORKERS`` (default: the scheduler's
+concurrency limit) instead of a hardcoded width.  ``GET /metrics``
 exposes the engine's telemetry registry (runtime/telemetry.py) in
 Prometheus text format — the same counters previously only reachable via
 ``physical.compiled.stats`` — and per-query wire stats carry the query's
@@ -17,6 +23,8 @@ from __future__ import annotations
 
 import json
 import logging
+import math
+import os
 import threading
 import time
 import uuid as uuid_mod
@@ -24,7 +32,8 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
-from ..runtime import resilience as _res, telemetry as _tel
+from ..runtime import (resilience as _res, scheduler as _sched,
+                       telemetry as _tel)
 
 logger = logging.getLogger(__name__)
 
@@ -47,11 +56,23 @@ def _stats(state: str, info: Optional["_QueryInfo"] = None) -> dict:
         "queuedTimeMillis": 0, "elapsedTimeMillis": 0, "processedRows": 0,
         "processedBytes": 0, "peakMemoryBytes": 0,
     }
+    # live saturation from the workload manager's gauges (not the old
+    # per-query 0/1 constants): presto clients polling ANY query see the
+    # process-wide queue depth and running count
+    mgr = _sched.get_manager()
+    if mgr.enabled():
+        out["queuedSplits"] = mgr.queue_depth()
+        out["runningSplits"] = mgr.running_count()
     if info is not None:
         now = time.monotonic()
         started = info.started or now
         finished = info.finished or now
-        out["queuedTimeMillis"] = int(1000 * (started - info.submitted))
+        if info.queued_ms is not None:
+            # the scheduler's own timestamps: seat claim at POST ->
+            # admission grant (covers pool wait + admission-queue wait)
+            out["queuedTimeMillis"] = int(info.queued_ms)
+        else:
+            out["queuedTimeMillis"] = int(1000 * (started - info.submitted))
         out["wallTimeMillis"] = int(1000 * max(finished - started, 0))
         out["elapsedTimeMillis"] = int(1000 * (finished - info.submitted))
         out["cpuTimeMillis"] = int(1000 * info.cpu_sec)
@@ -79,7 +100,8 @@ def _stats(state: str, info: Optional["_QueryInfo"] = None) -> dict:
 class _QueryInfo:
     __slots__ = ("submitted", "started", "finished", "cpu_sec", "rows",
                  "bytes", "peak_memory", "compiles", "cache_hits", "phases",
-                 "cache_hit", "cache_tier", "subplan_cache_hits")
+                 "cache_hit", "cache_tier", "subplan_cache_hits",
+                 "queued_ms")
 
     def __init__(self):
         self.submitted = time.monotonic()
@@ -95,10 +117,12 @@ class _QueryInfo:
         self.cache_hit = False
         self.cache_tier = None
         self.subplan_cache_hits = 0
+        self.queued_ms = None
 
 
 def _run_tracked(context, sql: str, info: _QueryInfo,
-                 cancel: Optional[threading.Event] = None):
+                 cancel: Optional[threading.Event] = None,
+                 seat: Optional[_sched.Seat] = None):
     from ..physical import compiled
 
     info.started = time.monotonic()
@@ -106,19 +130,27 @@ def _run_tracked(context, sql: str, info: _QueryInfo,
     # thread_time, not process_time: concurrent pool queries must not
     # inflate each other's cpu accounting
     cpu0 = time.thread_time()
+    _sched.clear_thread_queued_ms()
     try:
         # the cancel token joins the query's supervision scope
         # (runtime/resilience.py): DELETE /v1/cancel sets it and the
         # execution layers abandon queued stages / orphan in-flight
         # compiles at their next checkpoint, instead of running to the end
-        # behind a fut.cancel() that cannot stop a started future
-        with _res.query_scope(cancel=cancel):
+        # behind a fut.cancel() that cannot stop a started future.
+        # seat_scope hands the POST-time admission pre-claim to the
+        # workload manager, which consumes its timestamp + priority.
+        with _sched.seat_scope(seat), _res.query_scope(cancel=cancel):
             table = context.sql(sql)
     finally:
         info.cpu_sec = time.thread_time() - cpu0
         info.finished = time.monotonic()
         info.compiles = compiled.stats["compiles"] - c0["compiles"]
         info.cache_hits = compiled.stats["hits"] - c0["hits"]
+        # measured queue time from the scheduler's own timestamps; a DDL
+        # statement (no plan execution) leaves the seat unconsumed — give
+        # its queue position back
+        info.queued_ms = _sched.thread_queued_ms()
+        _sched.get_manager().release_seat(seat)
         # the report of the trace that just closed ON THIS THREAD — the
         # per-query phase split concurrent queries cannot clobber
         report = _tel.last_report()
@@ -180,13 +212,30 @@ def _data_payload(table) -> list:
 # server
 # ---------------------------------------------------------------------------
 
+def _server_workers() -> int:
+    """Worker-thread count: ``DSQL_SERVER_WORKERS``, defaulting to the
+    workload manager's concurrency limit (the pool no longer needs its own
+    magic width — the scheduler owns saturation policy; the pool just has
+    to keep every grantable slot busy).  4 when the scheduler is off,
+    matching the historical hardcoded pool."""
+    raw = os.environ.get("DSQL_SERVER_WORKERS", "")
+    try:
+        if raw and int(raw) > 0:
+            return int(raw)
+    except ValueError:
+        pass
+    mgr = _sched.get_manager()
+    return mgr.limit() if mgr.enabled() else 4
+
+
 class _AppState:
     def __init__(self, context):
         self.context = context
-        self.pool = ThreadPoolExecutor(max_workers=4)
+        self.pool = ThreadPoolExecutor(max_workers=_server_workers())
         self.future_list: Dict[str, Future] = {}
         self.query_info: Dict[str, _QueryInfo] = {}
         self.cancel_events: Dict[str, threading.Event] = {}
+        self.seats: Dict[str, _sched.Seat] = {}
         self.lock = threading.Lock()
 
 
@@ -195,11 +244,14 @@ def _make_handler(state: _AppState, base_url: str):
         def log_message(self, fmt, *args):
             logger.debug("server: " + fmt, *args)
 
-        def _send(self, code: int, payload: Optional[dict]):
+        def _send(self, code: int, payload: Optional[dict],
+                  headers: Optional[dict] = None):
             body = json.dumps(payload or {}).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -244,12 +296,14 @@ def _make_handler(state: _AppState, base_url: str):
                     del state.future_list[uid]
                     state.query_info.pop(uid, None)
                     state.cancel_events.pop(uid, None)
+                    state.seats.pop(uid, None)
                     _tel.inc("server_query_errors")
                     self._send(200, _error_payload(str(e), uid, exc=e))
                     return
                 del state.future_list[uid]
                 state.query_info.pop(uid, None)
                 state.cancel_events.pop(uid, None)
+                state.seats.pop(uid, None)
                 payload = {
                     "id": uid, "infoUri": base_url,
                     "stats": _stats("FINISHED", info),
@@ -270,12 +324,29 @@ def _make_handler(state: _AppState, base_url: str):
             sql = self.rfile.read(length).decode()
             _tel.inc("server_queries")
             uid = str(uuid_mod.uuid4())
+            # admission pre-claim at POST time: when every slot AND queue
+            # position is taken the client gets an immediate 429 with a
+            # Retry-After hint, instead of the query disappearing into an
+            # unbounded thread-pool backlog
+            priority = _sched.normalize_priority(
+                self.headers.get("X-DSQL-Priority"))
+            try:
+                seat = _sched.get_manager().claim_seat(priority)
+            except _res.AdmissionRejected as e:
+                _tel.inc("server_throttled")
+                self._send(429, _error_payload(str(e), uid, exc=e),
+                           headers={"Retry-After":
+                                    str(max(int(math.ceil(e.retry_after_s)),
+                                            1))})
+                return
             info = _QueryInfo()
             cancel = threading.Event()
             state.query_info[uid] = info
             state.cancel_events[uid] = cancel
+            if seat is not None:
+                state.seats[uid] = seat
             fut = state.pool.submit(_run_tracked, state.context, sql, info,
-                                    cancel)
+                                    cancel, seat)
             state.future_list[uid] = fut
             self._send(200, {
                 "id": uid, "infoUri": base_url,
@@ -291,9 +362,15 @@ def _make_handler(state: _AppState, base_url: str):
                 fut = state.future_list.pop(uid, None)
                 state.query_info.pop(uid, None)
                 cancel = state.cancel_events.pop(uid, None)
+                seat = state.seats.pop(uid, None)
                 if fut is None:
                     self._send(404, _error_payload("Unknown query id", uid))
                     return
+                # a query cancelled while still in the pool backlog never
+                # reaches _run_tracked — its admission pre-claim must not
+                # hold a queue position forever (idempotent: a consumed
+                # seat is a no-op)
+                _sched.get_manager().release_seat(seat)
                 # REAL cancellation, not just fut.cancel() (which is a
                 # no-op once the future started): the cancel token makes
                 # the running query raise QueryCancelled at its next

@@ -45,6 +45,12 @@ echo "=== [2d] result-cache smoke (reuse layer) ==="
 # table must invalidate, and DSQL_RESULT_CACHE_MB=0 must disable cleanly
 python scripts/cache_smoke.py
 
+echo "=== [2e] scheduler smoke (workload manager) ==="
+# 8 mixed-priority queries through a 2-slot scheduler: none lost,
+# interactive p50 queue time < batch p50, admission counters reconcile,
+# and DSQL_MAX_CONCURRENT_QUERIES=0 restores pre-subsystem behavior
+python scripts/sched_smoke.py
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \

@@ -1,0 +1,457 @@
+"""Unit tests for runtime/scheduler.py: admission bounds, deadline-aware
+rejection, deficit-weighted priority pick with aging, the memory ledger
+(incl. the result cache's tenancy + pressure shrink), seats, and the
+telemetry name-contract additions."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dask_sql_tpu.runtime import faults
+from dask_sql_tpu.runtime import resilience as res
+from dask_sql_tpu.runtime import result_cache as rc
+from dask_sql_tpu.runtime import scheduler as sched
+from dask_sql_tpu.runtime import telemetry as tel
+from dask_sql_tpu.table import Table
+
+
+@pytest.fixture()
+def mgr(monkeypatch):
+    """A fresh manager: 1 slot, small queue, fast timeout, broker off."""
+    monkeypatch.setenv("DSQL_MAX_CONCURRENT_QUERIES", "1")
+    monkeypatch.setenv("DSQL_QUEUE_DEPTH", "2")
+    monkeypatch.setenv("DSQL_QUEUE_TIMEOUT_MS", "60000")
+    monkeypatch.setenv("DSQL_DEVICE_BUDGET_MB", "0")
+    return sched.WorkloadManager()
+
+
+def _table(n_rows: int) -> Table:
+    return Table.from_pydict({"a": np.zeros(n_rows, dtype=np.int64)})
+
+
+def _counter_delta(fn, *names):
+    before = {n: tel.REGISTRY.get(n) for n in names}
+    fn()
+    return {n: tel.REGISTRY.get(n) - before[n] for n in names}
+
+
+# ---------------------------------------------------------------------------
+# enable/disable + basic admission
+# ---------------------------------------------------------------------------
+
+def test_disabled_at_zero(monkeypatch):
+    monkeypatch.setenv("DSQL_MAX_CONCURRENT_QUERIES", "0")
+    m = sched.WorkloadManager()
+    assert not m.enabled()
+    assert m.claim_seat("interactive") is None
+    with m.admission() as ticket:
+        assert ticket is None
+
+
+def test_immediate_admission_and_release(mgr):
+    t = mgr.acquire("interactive", 0)
+    assert t.admitted and mgr.running_count() == 1
+    assert t.queued_ms is not None and t.queued_ms >= 0
+    mgr.release(t)
+    assert mgr.running_count() == 0
+    # double release is a no-op
+    mgr.release(t)
+    assert mgr.running_count() == 0
+
+
+def test_admission_counters_reconcile(mgr):
+    def run():
+        t = mgr.acquire("batch", 0)
+        mgr.release(t)
+    d = _counter_delta(run, "sched_admitted_batch", "sched_rejected_batch",
+                       "sched_timeout_batch")
+    assert d == {"sched_admitted_batch": 1, "sched_rejected_batch": 0,
+                 "sched_timeout_batch": 0}
+
+
+def test_queue_full_rejects(mgr):
+    holder = mgr.acquire("interactive", 0)
+    admitted = []
+
+    def wait(i):
+        t = mgr.acquire("interactive", 0)
+        admitted.append(i)
+        mgr.release(t)         # pass the slot on so every waiter drains
+
+    threads = [threading.Thread(target=wait, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 5
+    while mgr.queue_depth() < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    assert mgr.queue_depth() == 2
+    # slot busy + depth(2) full -> immediate typed rejection
+    with pytest.raises(res.AdmissionRejected) as exc:
+        mgr.acquire("interactive", 0)
+    assert exc.value.retry_after_s >= 0
+    assert exc.value.error_type == "INSUFFICIENT_RESOURCES"
+    mgr.release(holder)
+    for t in threads:
+        t.join(timeout=5)
+    assert sorted(admitted) == [0, 1]
+    assert mgr.running_count() == 0
+
+
+def test_queue_timeout(mgr, monkeypatch):
+    monkeypatch.setenv("DSQL_QUEUE_TIMEOUT_MS", "80")
+    holder = mgr.acquire("interactive", 0)
+    t0 = time.monotonic()
+    with pytest.raises(res.AdmissionTimeout):
+        mgr.acquire("interactive", 0)
+    assert time.monotonic() - t0 < 5.0
+    assert mgr.queue_depth() == 0        # the abandoned waiter left no ghost
+    mgr.release(holder)
+
+
+def test_timeout_counter_keeps_reconciliation(mgr, monkeypatch):
+    monkeypatch.setenv("DSQL_QUEUE_TIMEOUT_MS", "50")
+    holder = mgr.acquire("background", 0)
+
+    def run():
+        with pytest.raises(res.AdmissionTimeout):
+            mgr.acquire("background", 0)
+
+    d = _counter_delta(run, "sched_timeout_background",
+                       "sched_admitted_background")
+    assert d["sched_timeout_background"] == 1
+    assert d["sched_admitted_background"] == 0
+    mgr.release(holder)
+
+
+def test_deadline_expiry_rejects_before_enqueue(mgr):
+    holder = mgr.acquire("interactive", 0)
+    # seed the hold-time EWMA: the only admitted query "ran" ~10 s
+    mgr._run_ewma_s = 10.0
+    with res.query_scope(timeout_s=0.2):
+        with pytest.raises(res.AdmissionRejected) as exc:
+            mgr.acquire("interactive", 0)
+    assert "deadline" in str(exc.value)
+    mgr.release(holder)
+
+
+def test_no_deadline_rejection_without_history(mgr, monkeypatch):
+    """Without an EWMA there is no estimate — never reject on a guess; the
+    queued wait itself still honours the deadline via resilience.check."""
+    monkeypatch.setenv("DSQL_QUEUE_TIMEOUT_MS", "60000")
+    holder = mgr.acquire("interactive", 0)
+    assert mgr._run_ewma_s is None
+    with res.query_scope(timeout_s=0.1):
+        with pytest.raises(res.DeadlineExceeded):
+            mgr.acquire("interactive", 0)
+    mgr.release(holder)
+
+
+def test_queued_wait_honors_cancellation(mgr):
+    holder = mgr.acquire("interactive", 0)
+    cancel = threading.Event()
+    err = []
+
+    def wait():
+        try:
+            with res.query_scope(cancel=cancel):
+                mgr.acquire("interactive", 0)
+        except BaseException as e:   # noqa: BLE001 - recording the verdict
+            err.append(e)
+
+    t = threading.Thread(target=wait)
+    t.start()
+    deadline = time.time() + 5
+    while mgr.queue_depth() < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    cancel.set()
+    t.join(timeout=5)
+    assert err and isinstance(err[0], res.QueryCancelled)
+    mgr.release(holder)
+
+
+# ---------------------------------------------------------------------------
+# priority ordering + aging
+# ---------------------------------------------------------------------------
+
+def _run_contended(mgr, submissions):
+    """Occupy the single slot, enqueue ``submissions`` [(priority, tag)],
+    then release and record admission order."""
+    holder = mgr.acquire("background", 0)
+    order, lock = [], threading.Lock()
+
+    def go(priority, tag):
+        t = mgr.acquire(priority, 0)
+        with lock:
+            order.append(tag)
+        time.sleep(0.01)
+        mgr.release(t)
+
+    threads = []
+    for priority, tag in submissions:
+        th = threading.Thread(target=go, args=(priority, tag))
+        th.start()
+        threads.append(th)
+        # deterministic enqueue order
+        deadline = time.time() + 5
+        while mgr.queue_depth() < len(threads) and time.time() < deadline:
+            time.sleep(0.005)
+    mgr.release(holder)
+    for th in threads:
+        th.join(timeout=10)
+    return order
+
+
+def test_interactive_beats_batch(mgr, monkeypatch):
+    monkeypatch.setenv("DSQL_QUEUE_DEPTH", "8")
+    order = _run_contended(mgr, [("batch", "b1"), ("batch", "b2"),
+                                 ("interactive", "i1"),
+                                 ("interactive", "i2")])
+    assert len(order) == 4
+    # the first grant after the slot frees goes to the interactive class
+    # even though both batch queries enqueued first
+    assert order[0] == "i1"
+
+
+def test_weighted_interleave_serves_both(mgr, monkeypatch):
+    monkeypatch.setenv("DSQL_QUEUE_DEPTH", "8")
+    order = _run_contended(mgr, [("batch", "b1"), ("interactive", "i1"),
+                                 ("batch", "b2"), ("interactive", "i2")])
+    # deficit-weighted, not absolute: batch is served within the window,
+    # not starved until interactive drains
+    assert order.index("b1") < 3
+
+
+def test_pick_is_starvation_free(mgr):
+    """White-box DWRR check: under a standing interactive queue, the
+    background head must still win within a bounded number of rounds
+    (deficit carry + aging boost)."""
+    now = time.monotonic()
+    for _ in range(50):
+        mgr._waiting["interactive"].append(
+            sched.Ticket("interactive", 0, now))
+    mgr._waiting["background"].append(sched.Ticket("background", 0, now))
+    picks = [mgr._pick_locked() for _ in range(12)]
+    assert "background" in picks
+    # service is weighted: interactive dominates the window
+    assert picks.count("interactive") > picks.count("background")
+    for q in mgr._waiting.values():
+        q.clear()
+
+
+def test_aging_boost_promotes_old_waiter(mgr, monkeypatch):
+    monkeypatch.setenv("DSQL_QUEUE_AGING_MS", "100")
+    now = time.monotonic()
+    # a background query that has waited 2 s (20 aging units) outranks a
+    # fresh interactive arrival (weight 8) on the very first pick
+    mgr._waiting["background"].append(
+        sched.Ticket("background", 0, now - 2.0))
+    mgr._waiting["interactive"].append(
+        sched.Ticket("interactive", 0, now))
+    assert mgr._pick_locked() == "background"
+    for q in mgr._waiting.values():
+        q.clear()
+
+
+# ---------------------------------------------------------------------------
+# seats (the server's POST-time pre-claims)
+# ---------------------------------------------------------------------------
+
+def test_seat_claim_bounds_and_release(mgr):
+    holder = mgr.acquire("interactive", 0)
+    s1 = mgr.claim_seat("interactive")
+    s2 = mgr.claim_seat("interactive")
+    assert mgr.queue_depth() == 2
+    # 1 running + 0 waiting + 2 seats == limit(1) + depth(2): full
+    with pytest.raises(res.AdmissionRejected):
+        mgr.claim_seat("interactive")
+    mgr.release_seat(s1)
+    assert mgr.queue_depth() == 1
+    # releasing twice is a no-op
+    mgr.release_seat(s1)
+    assert mgr.queue_depth() == 1
+    mgr.release_seat(s2)
+    mgr.release(holder)
+
+
+def test_seat_transfers_enqueue_timestamp(mgr):
+    seat = mgr.claim_seat("batch")
+    time.sleep(0.05)
+    t = mgr.acquire("batch", 0, seat=seat)
+    assert seat.consumed
+    assert mgr.queue_depth() == 0
+    # queue time is measured from the seat claim, not the acquire call
+    assert t.queued_ms >= 40
+    mgr.release(t)
+
+
+# ---------------------------------------------------------------------------
+# memory broker: ledger arithmetic + cache tenancy
+# ---------------------------------------------------------------------------
+
+def test_ledger_reserve_release(monkeypatch):
+    monkeypatch.setenv("DSQL_DEVICE_BUDGET_MB", "1")     # 1 MiB
+    monkeypatch.setenv("DSQL_RESULT_CACHE_MB", "0")
+    ledger = sched.MemoryLedger(cache_fn=rc.ResultCache)
+    got = ledger.reserve(512 * 1024)
+    assert got == 512 * 1024
+    # over-reservation fails (queues at the manager) instead of going
+    # negative
+    assert ledger.reserve(768 * 1024) is None
+    ledger.release(got)
+    assert ledger.reserved_bytes() == 0
+    # estimates larger than the whole budget clamp so a lone query runs
+    assert ledger.reserve(10 * 2**20) == 2**20
+    ledger.release(2**20)
+
+
+def test_ledger_disabled_at_zero(monkeypatch):
+    monkeypatch.setenv("DSQL_DEVICE_BUDGET_MB", "0")
+    ledger = sched.MemoryLedger(cache_fn=rc.ResultCache)
+    assert ledger.reserve(1 << 40) == 0      # admission-only mode
+    assert ledger.reserved_bytes() == 0
+
+
+def test_reservation_shrinks_cache_tenant(monkeypatch):
+    monkeypatch.setenv("DSQL_DEVICE_BUDGET_MB", "1")
+    monkeypatch.setenv("DSQL_RESULT_CACHE_MB", "1")
+    monkeypatch.setenv("DSQL_RESULT_CACHE_HOST_MB", "4")
+    cache = rc.ResultCache()
+    ledger = sched.MemoryLedger(cache_fn=lambda: cache)
+    # ~0.75 MiB resident in the cache's device tier
+    cache.put(rc.CacheKey("k1", ()), _table(48 * 1024))
+    cache.put(rc.CacheKey("k2", ()), _table(48 * 1024))
+    resident = cache.device_bytes
+    assert resident > 512 * 1024
+    # a 0.75 MiB reservation cannot fit next to it: the cache must spill
+    got = ledger.reserve(768 * 1024)
+    assert got == 768 * 1024
+    assert cache.device_bytes <= 2**20 - 768 * 1024
+    # the displaced entries moved to host, they were not destroyed
+    assert cache.host_bytes > 0
+    assert cache.get(rc.CacheKey("k1", ())) is not None
+    ledger.release(got)
+    cache.clear()
+
+
+def test_shrink_device_to_drops_when_host_full(monkeypatch):
+    monkeypatch.setenv("DSQL_RESULT_CACHE_MB", "4")
+    monkeypatch.setenv("DSQL_RESULT_CACHE_HOST_MB", "0")
+    cache = rc.ResultCache()
+    cache.put(rc.CacheKey("k1", ()), _table(1024))
+    assert cache.device_bytes > 0
+    freed = cache.shrink_device_to(0)
+    assert freed > 0
+    assert cache.device_bytes == 0 and cache.host_bytes == 0
+
+
+def test_cache_device_budget_is_ledger_tenant(monkeypatch):
+    """With the global manager armed, the cache's effective device budget
+    shrinks to the ledger headroom — but liveness (enabled) follows the
+    BASE budget, so pressure never clears the whole cache."""
+    monkeypatch.setenv("DSQL_MAX_CONCURRENT_QUERIES", "2")
+    monkeypatch.setenv("DSQL_DEVICE_BUDGET_MB", "1")
+    monkeypatch.setenv("DSQL_RESULT_CACHE_MB", "64")
+    cache = rc.ResultCache()
+    mgr = sched.get_manager()
+    assert cache.device_budget() == 2**20         # min(64 MiB, 1 MiB free)
+    got = mgr.ledger.reserve(512 * 1024)
+    try:
+        assert cache.device_budget() == 512 * 1024
+        assert cache.enabled()
+    finally:
+        mgr.ledger.release(got)
+
+
+def test_over_reservation_queues_until_release(mgr, monkeypatch):
+    monkeypatch.setenv("DSQL_MAX_CONCURRENT_QUERIES", "2")
+    monkeypatch.setenv("DSQL_DEVICE_BUDGET_MB", "1")
+    t1 = mgr.acquire("interactive", 800 * 1024)
+    assert t1.reserved_bytes == 800 * 1024
+    admitted = []
+
+    def wait():
+        # fits the slot count (2) but not the ledger: must queue, not crash
+        t2 = mgr.acquire("interactive", 800 * 1024)
+        admitted.append(t2)
+
+    th = threading.Thread(target=wait)
+    th.start()
+    time.sleep(0.15)
+    assert not admitted and mgr.queue_depth() == 1
+    mgr.release(t1)                     # frees the ledger -> dispatch
+    th.join(timeout=5)
+    assert admitted and admitted[0].reserved_bytes == 800 * 1024
+    mgr.release(admitted[0])
+
+
+# ---------------------------------------------------------------------------
+# working-set estimator + admission context manager
+# ---------------------------------------------------------------------------
+
+def test_estimate_plan_bytes_scales_with_operators():
+    import pandas as pd
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.sql.parser import parse_sql
+
+    c = Context()
+    c.create_table("t", pd.DataFrame({"a": np.arange(10_000),
+                                      "b": np.arange(10_000) * 1.5}))
+
+    def est(sql):
+        plan = c._get_plan(parse_sql(sql)[0].query, sql)
+        return sched.estimate_plan_bytes(plan, c)
+
+    floor = sched._MIN_ESTIMATE
+    scan = est("SELECT a, b FROM t") - floor
+    agg = est("SELECT a, SUM(b) FROM t GROUP BY a") - floor
+    join = est("SELECT x.a FROM t x, t y WHERE x.a = y.a") - floor
+    assert scan >= 10_000 * 16
+    assert agg > scan            # aggregate multiplier
+    assert join > 2 * scan       # two scans x join multiplier
+
+
+def test_admission_nested_rides_outer_slot(mgr):
+    with mgr.admission(priority="interactive") as outer:
+        assert outer is not None
+        assert mgr.running_count() == 1
+        with mgr.admission(priority="interactive") as inner:
+            assert inner is None          # nested plan: no second slot
+            assert mgr.running_count() == 1
+    assert mgr.running_count() == 0
+
+
+def test_admission_fault_site(mgr):
+    with faults.inject("admission:1"):
+        with pytest.raises(faults.FaultInjected):
+            with mgr.admission(priority="batch"):
+                pass  # pragma: no cover - admission raised
+    # the fault consumed no slot and the next admission works
+    assert mgr.running_count() == 0 and mgr.queue_depth() == 0
+    with mgr.admission(priority="batch") as t:
+        assert t is not None
+
+
+# ---------------------------------------------------------------------------
+# telemetry contract additions
+# ---------------------------------------------------------------------------
+
+def test_sched_names_in_stable_contract():
+    for name in ("sched_admitted_interactive", "sched_admitted_batch",
+                 "sched_admitted_background", "sched_rejected_interactive",
+                 "sched_rejected_batch", "sched_rejected_background",
+                 "sched_timeout_interactive", "sched_timeout_batch",
+                 "sched_timeout_background", "fault_admission",
+                 "server_throttled"):
+        assert name in tel.STABLE_COUNTERS
+    for name in ("sched_queue_depth", "sched_running",
+                 "sched_reserved_bytes"):
+        assert name in tel.STABLE_GAUGES
+
+
+def test_gauges_track_queue_and_running(mgr):
+    t = mgr.acquire("interactive", 0)
+    assert tel.REGISTRY.get_gauge("sched_running") == 1
+    mgr.release(t)
+    assert tel.REGISTRY.get_gauge("sched_running") == 0
