@@ -64,8 +64,9 @@ from ..plan.nodes import (
     LogicalTableScan, LogicalUnion, LogicalValues, LogicalWindow, RelNode,
     RexCall, RexInputRef, RexLiteral, RexNode,
 )
-from ..runtime import (faults as _faults, resilience as _res,
-                       result_cache as _rcache, telemetry as _tel)
+from ..runtime import (faults as _faults, quarantine as _quar,
+                       resilience as _res, result_cache as _rcache,
+                       telemetry as _tel)
 from ..table import dict_sort_order, Column, Scalar, Table
 from .rex.evaluate import evaluate_predicate, evaluate_rex
 from .stages import (StageGraph, heavy_count as _heavy_count,
@@ -2101,6 +2102,13 @@ def _degrade_compile(plan: RelNode, context, base_key, key, exc: Exception,
         with _state_lock:
             _cache[key] = _UNSUPPORTED
         _tel.inc("exiled")
+        # cross-process exile (runtime/quarantine.py): the FATAL verdict
+        # persists keyed by plan + input layout + device fingerprint, so a
+        # restarted process serves this plan eager WITHOUT re-paying the
+        # doomed compile; expiry + half-open probes un-quarantine a fixed
+        # engine eventually
+        _quar.get_store().mark(_quar.program_key(base_key), "fatal",
+                               reason=str(err)[:200])
     if os.environ.get("DSQL_EAGER_FALLBACK", "1") == "0":
         raise err if err is exc else err from exc
     logger.warning("compiled path failed for this plan (%s); using eager "
@@ -2425,37 +2433,75 @@ def _execute_stage_graph_inner(graph: StageGraph, context, query_fp: str,
     tel_trace = _tel.current_trace()
     tel_parent = _tel.current_span()
 
+    def run_stage_once(idx: int, attempt: int) -> Optional[Table]:
+        _tel.inc("stage_execs")
+        if attempt > 0:
+            # the replay path is itself an injection site (checked FIRST,
+            # so arming both sites sabotages the replay rather than just
+            # re-firing the original), so CI can prove a sabotaged replay
+            # still degrades cleanly
+            _faults.maybe_fail("stage_replay")
+        _faults.maybe_fail("stage_exec")
+        st = stages[idx]
+        # subplan result cache: a non-root stage's boundary name is a
+        # content digest of its subtree (scan uids included), so an
+        # OVERLAPPING query sharing the subplan replays the
+        # materialized stage output and skips its device execution —
+        # data reuse on top of the program reuse the stage cache gives
+        skey = None
+        cache = _rcache.get_cache()
+        if st.scan is not None and cache.enabled():
+            skey = _rcache.stage_key(st.scan.table_name)
+            hit = cache.get(skey)
+            if hit is not None:
+                _tel.inc("result_cache_subplan_hits")
+                _tel.annotate(subplan_cache="hit",
+                              result_cache_tier=hit[1])
+                return hit[0]
+        out = _execute_single(st.plan, context, query_fp,
+                              split_limit, in_stage=True)
+        if skey is not None and out is not None:
+            cache.put(skey, out)
+        return out
+
     def run_stage(idx: int) -> Optional[Table]:
         # worker threads re-enter the query's supervision scope AND its
-        # telemetry trace (thread locals do not cross pools); the
-        # stage_exec fault site gets its own in-place retry so an injected
-        # transient behaves like a recoverable per-stage blip, not a
-        # whole-graph failure
+        # telemetry trace (thread locals do not cross pools).
+        # Checkpointed stage replay: a transient failure re-executes ONLY
+        # this stage — its dependencies' outputs are already materialized
+        # as registered boundary temps, so the retry rescans them instead
+        # of re-running the stages that produced them.  The failure
+        # domain is one stage, not the graph (let alone the query).
         with _res.scoped(rt), _tel.scoped(tel_trace, tel_parent), \
                 _tel.span("stage", index=idx):
-            _res.retry_transient(
-                lambda: _faults.maybe_fail("stage_exec"), site="stage_exec")
-            st = stages[idx]
-            # subplan result cache: a non-root stage's boundary name is a
-            # content digest of its subtree (scan uids included), so an
-            # OVERLAPPING query sharing the subplan replays the
-            # materialized stage output and skips its device execution —
-            # data reuse on top of the program reuse the stage cache gives
-            skey = None
-            cache = _rcache.get_cache()
-            if st.scan is not None and cache.enabled():
-                skey = _rcache.stage_key(st.scan.table_name)
-                hit = cache.get(skey)
-                if hit is not None:
-                    _tel.inc("result_cache_subplan_hits")
-                    _tel.annotate(subplan_cache="hit",
-                                  result_cache_tier=hit[1])
-                    return hit[0]
-            out = _execute_single(st.plan, context, query_fp,
-                                  split_limit, in_stage=True)
-            if skey is not None and out is not None:
-                cache.put(skey, out)
-            return out
+            attempt = 0
+            while True:
+                _res.check("stage_exec")
+                try:
+                    return run_stage_once(idx, attempt)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    err = _res.classify(e)
+                    if err is None:
+                        raise
+                    if not isinstance(err, _res.TransientError):
+                        raise err if err is e else err from e
+                    attempt += 1
+                    if attempt > _res.retry_max():
+                        raise err if err is e else err from e
+                    saved = len(registered)
+                    _tel.inc("retries")
+                    _tel.inc("stage_replays")
+                    _tel.inc("stage_replay_saved_stages", saved)
+                    _tel.annotate(stage_replays=attempt,
+                                  stage_replay_saved=saved)
+                    logger.warning(
+                        "stage %d failed transiently (%s); replaying it "
+                        "from %d materialized boundary stage(s) — retry "
+                        "%d/%d", idx, str(err)[:200], saved, attempt,
+                        _res.retry_max())
+                    _res.backoff(attempt, "stage_exec")
 
     def stage_error(e: Exception) -> Optional[BaseException]:
         """None => degrade the whole graph to eager; else raise this.
@@ -2698,15 +2744,45 @@ def _execute_single(plan: RelNode, context, query_fp: str,
         flat = _flatten_tables(scans)
         if entry is None:
             degrade = None
+            qstore = _quar.get_store()
+            qkey = _quar.program_key(base_key)
             try:
                 with _tel.span("compile"):
+                    verdict = qstore.check(qkey) if qstore.enabled() else None
+                    if verdict == "quarantined":
+                        # cross-process exile: some process crashed or hung
+                        # on this exact program (plan + layout + device) and
+                        # the verdict is still live — serve eager with NO
+                        # compile attempt (the finally releases the
+                        # in-flight claim)
+                        _tel.inc("quarantine_skips")
+                        _tel.annotate(quarantined=True)
+                        logger.warning(
+                            "program is quarantined (crash/hang on a prior "
+                            "process); skipping compile, serving eager")
+                        return None
+                    if verdict == "probe":
+                        # half-open: this one caller re-attempts the compile
+                        # while everyone else keeps skipping; success below
+                        # lifts the verdict, failure re-arms it
+                        _tel.inc("quarantine_probes")
+                        _tel.annotate(quarantine_probe=True)
                     attempt = 0
                     while True:  # in-rung transient retries (resilience.LADDER)
                         try:
-                            _faults.maybe_fail("compile")
-                            entry = _build(plan, context, scans, caps, key,
-                                           origin=query_fp)
-                            outs = entry.fn(*flat)  # first call traces+compiles
+                            # the watchdog observes wall time from OUTSIDE
+                            # the worker: a compile wedged inside XLA never
+                            # reaches a cooperative check(), but its
+                            # fingerprint still gets marked suspect (the
+                            # injected compile fault stands in for such a
+                            # stall, so it sits inside the watched section)
+                            with _quar.get_watchdog().watch(
+                                    qkey, label=plan_fp[:60]):
+                                _faults.maybe_fail("compile")
+                                entry = _build(plan, context, scans, caps,
+                                               key, origin=query_fp)
+                                # first call traces+compiles
+                                outs = entry.fn(*flat)
                             break
                         except Unsupported as e:
                             logger.debug("not compilable at trace time: %s", e)
@@ -2755,6 +2831,12 @@ def _execute_single(plan: RelNode, context, query_fp: str,
                     _tel.inc("compiles")
                     if in_stage:
                         _tel.inc("stage_compiles")
+                    if qstore.enabled():
+                        # a successful compile (half-open probe, or a
+                        # watchdog trip that finished after all) lifts any
+                        # surviving verdict — a fixed engine un-quarantines
+                        # itself
+                        qstore.clear(qkey)
                     with _state_lock:
                         while len(_cache) >= _CACHE_LIMIT:
                             _cache.popitem(last=False)

@@ -14,6 +14,11 @@
                   deficit-weighted priority classes with anti-starvation
                   aging, and the shared device-bytes ledger the result
                   cache is a tenant of.
+``quarantine``  — cross-process crash/hang quarantine (a JSON store of
+                  verdicts keyed by program + device fingerprint, with
+                  expiry and half-open probes) plus the compile watchdog
+                  that catches builds wedged inside XLA where cooperative
+                  deadline checks cannot run.
 """
-from . import (faults, resilience, result_cache, scheduler,  # noqa: F401
-               telemetry)
+from . import (faults, quarantine, resilience, result_cache,  # noqa: F401
+               scheduler, telemetry)

@@ -149,6 +149,15 @@ class AdmissionTimeout(AdmissionRejected):
     error_name = "QUERY_QUEUE_TIMEOUT"
 
 
+class ServerDraining(AdmissionRejected):
+    """The process is draining (SIGTERM/SIGINT): in-flight queries run to
+    completion within ``DSQL_DRAIN_TIMEOUT_S`` but NEW admissions are
+    refused — the server surfaces this as HTTP 503 + ``Retry-After`` so a
+    load balancer retries against another instance."""
+
+    error_name = "SERVER_SHUTTING_DOWN"
+
+
 # exception type NAMES (not imports: the parser/binder layer must stay
 # importable without this module) that are user mistakes by construction
 _USER_ERROR_NAMES = frozenset({
@@ -220,15 +229,22 @@ def _env_int(name: str, default: int) -> int:
 
 
 class QueryRuntime:
-    """Deadline + cancel token one query's execution threads share."""
+    """Deadline + cancel token one query's execution threads share.
 
-    __slots__ = ("deadline_at", "cancel")
+    ``backoff_s`` accumulates wall time this query spent SLEEPING in
+    retry backoff while holding resources — the workload manager subtracts
+    it from the slot-hold time feeding its queue-wait EWMA, so a query
+    riding a long in-rung retry chain does not inflate the admission
+    estimator (and spuriously fast-reject queued work)."""
+
+    __slots__ = ("deadline_at", "cancel", "backoff_s")
 
     def __init__(self, timeout_s: Optional[float] = None,
                  cancel: Optional[threading.Event] = None):
         self.deadline_at = (None if timeout_s is None
                             else time.monotonic() + max(timeout_s, 0.0))
         self.cancel = cancel
+        self.backoff_s = 0.0
 
     def remaining(self) -> Optional[float]:
         if self.deadline_at is None:
@@ -336,7 +352,13 @@ def backoff_s(attempt: int) -> float:
 def backoff(attempt: int, site: str = "") -> None:
     """Sleep before retry ``attempt`` — but never past the deadline: if the
     budget cannot cover the sleep, raise DeadlineExceeded NOW instead of
-    burning the remainder on a doomed wait."""
+    burning the remainder on a doomed wait.
+
+    The sleep runs under a ``retry_backoff`` telemetry span and accrues
+    into ``QueryRuntime.backoff_s``, so slot-hold accounting (the
+    scheduler's queue-wait EWMA) can subtract time spent deliberately
+    idle from time spent actually computing."""
+    from . import telemetry as _tel
     delay = backoff_s(attempt)
     rt = current()
     if rt is not None:
@@ -347,7 +369,16 @@ def backoff(attempt: int, site: str = "") -> None:
                 f"deadline cannot cover retry backoff at {site or 'site'} "
                 f"({delay * 1e3:.0f} ms needed, {max(rem, 0) * 1e3:.0f} ms "
                 "left)")
-    interruptible_sleep(delay, site)
+    t0 = time.monotonic()
+    try:
+        with _tel.span("retry_backoff", site=site, attempt=attempt):
+            interruptible_sleep(delay, site)
+    finally:
+        # the actually-slept wall (an interrupting deadline/cancel cuts it
+        # short), accumulated even on the exception path — the time was
+        # spent either way
+        if rt is not None:
+            rt.backoff_s += time.monotonic() - t0
 
 
 def retry_transient(fn: Callable, *, site: str,

@@ -50,6 +50,16 @@ A reservation that still cannot fit leaves the query queued (over-
 reservation queues rather than crashes); estimates larger than the whole
 budget are clamped so the query can run once it is alone.
 
+**Drain.**  ``begin_drain()`` (flipped by the server's SIGTERM/SIGINT
+handling, server/app.py) refuses every NEW admission with the typed
+:class:`resilience.ServerDraining` (HTTP 503 + ``Retry-After`` at the
+server) while in-flight queries keep their slots and finish within
+``DSQL_DRAIN_TIMEOUT_S``; the ``server_draining`` gauge is 1 for the
+duration.  The hold-time EWMA feeding the queue-wait estimate subtracts
+retry-backoff sleep (``Ticket.backoff_s``, accrued by
+``resilience.backoff``) so a query riding a long in-rung retry chain
+cannot inflate the estimator and trigger spurious deadline fast-rejects.
+
 Telemetry: ``sched_queue_depth`` / ``sched_running`` /
 ``sched_reserved_bytes`` gauges, per-class
 ``sched_admitted_*``/``sched_rejected_*``/``sched_timeout_*`` counters
@@ -75,7 +85,8 @@ from typing import Dict, Optional
 
 from . import faults as _faults, telemetry as _tel
 from . import resilience as _res
-from .resilience import AdmissionRejected, AdmissionTimeout, _env_int
+from .resilience import (AdmissionRejected, AdmissionTimeout, ServerDraining,
+                         _env_int)
 
 logger = logging.getLogger(__name__)
 
@@ -92,6 +103,14 @@ DEFAULT_QUEUE_DEPTH = 32
 DEFAULT_QUEUE_TIMEOUT_MS = 30_000
 DEFAULT_AGING_MS = 2_000
 DEFAULT_DEVICE_BUDGET_MB = 4_096
+DEFAULT_DRAIN_TIMEOUT_S = 30
+
+
+def drain_timeout_s() -> float:
+    """How long a draining process waits for in-flight queries before
+    typed cancellation (``DSQL_DRAIN_TIMEOUT_S``)."""
+    return float(max(_env_int("DSQL_DRAIN_TIMEOUT_S",
+                              DEFAULT_DRAIN_TIMEOUT_S), 1))
 
 # deficit clamp: bounds the catch-up burst a long-unserved (or long-empty)
 # class can accumulate, so one stale credit pile cannot monopolize a window
@@ -247,7 +266,8 @@ class Ticket:
     """One query's passage through admission: enqueue -> admit -> release."""
 
     __slots__ = ("priority", "est_bytes", "reserved_bytes", "enqueued_at",
-                 "admitted_at", "queued_ms", "admitted", "released")
+                 "admitted_at", "queued_ms", "admitted", "released",
+                 "backoff_s")
 
     def __init__(self, priority: str, est_bytes: int, enqueued_at: float):
         self.priority = priority
@@ -258,6 +278,11 @@ class Ticket:
         self.queued_ms: Optional[float] = None
         self.admitted = False
         self.released = False
+        # retry-backoff sleep accrued while holding the slot (filled at
+        # release from QueryRuntime.backoff_s): subtracted from the
+        # hold-time EWMA so in-rung retries cannot inflate the admission
+        # queue-wait estimate
+        self.backoff_s = 0.0
 
 
 class Seat:
@@ -339,7 +364,31 @@ class WorkloadManager:
             p: deque() for p in PRIORITIES}
         self._deficit: Dict[str, float] = {p: 0.0 for p in PRIORITIES}
         self._run_ewma_s: Optional[float] = None
+        self._drain = threading.Event()
         self.ledger = MemoryLedger(cache_fn)
+
+    # -- drain (SIGTERM/SIGINT graceful shutdown) ---------------------------
+    def begin_drain(self) -> None:
+        """Flip into draining: in-flight queries keep their slots and run
+        to completion, but every NEW admission (seat claim or acquire)
+        raises the typed ServerDraining verdict — the server surfaces it
+        as HTTP 503 + Retry-After.  Independent of ``enabled()``: a
+        process on its way out refuses new work even with the scheduler
+        subsystem off."""
+        self._drain.set()
+        _tel.REGISTRY.set_gauge("server_draining", 1)
+
+    def end_drain(self) -> None:
+        self._drain.clear()
+        _tel.REGISTRY.set_gauge("server_draining", 0)
+
+    def draining(self) -> bool:
+        return self._drain.is_set()
+
+    def _drain_verdict(self) -> ServerDraining:
+        return ServerDraining(
+            "server is draining (shutdown in progress); retry against "
+            "another instance", retry_after_s=drain_timeout_s())
 
     # -- config (env-read per call, like the result cache, so tests and
     # -- operators can flip knobs without a restart) ------------------------
@@ -386,6 +435,9 @@ class WorkloadManager:
         """Claim a place in line at submit time; raises AdmissionRejected
         (HTTP 429 at the server) when running + queued + seats already fill
         every slot and queue position."""
+        if self.draining():
+            _tel.inc(f"sched_rejected_{normalize_priority(priority)}")
+            raise self._drain_verdict()
         if not self.enabled():
             return None
         priority = normalize_priority(priority)
@@ -429,6 +481,9 @@ class WorkloadManager:
         """
         _faults.maybe_fail("admission")
         priority = normalize_priority(priority)
+        if self.draining():
+            _tel.inc(f"sched_rejected_{priority}")
+            raise self._drain_verdict()
         enqueued_at = seat.enqueued_at if seat is not None else \
             time.monotonic()
         ticket = Ticket(priority, int(est_bytes), enqueued_at)
@@ -577,7 +632,12 @@ class WorkloadManager:
         self._running = max(self._running - 1, 0)
         self.ledger.release(ticket.reserved_bytes)
         if ticket.admitted_at is not None:
-            held = time.monotonic() - ticket.admitted_at
+            # hold time minus retry-backoff sleeps: the EWMA estimates how
+            # long a slot stays BUSY, and a query asleep in backoff is not
+            # representative work — counting it inflated queue-wait
+            # estimates and triggered spurious deadline fast-rejects
+            held = max(time.monotonic() - ticket.admitted_at
+                       - max(ticket.backoff_s, 0.0), 0.0)
             self._run_ewma_s = (held if self._run_ewma_s is None
                                 else 0.3 * held + 0.7 * self._run_ewma_s)
         self._dispatch_locked()
@@ -618,11 +678,17 @@ class WorkloadManager:
             ticket = self.acquire(pr, est, seat=seat)
             _tel.annotate(queued_ms=round(ticket.queued_ms or 0.0, 3),
                           reserved_bytes=ticket.reserved_bytes)
+        rt = _res.current()
+        backoff0 = rt.backoff_s if rt is not None else 0.0
         _tls.ticket = ticket
         try:
             yield ticket
         finally:
             _tls.ticket = None
+            if rt is not None:
+                # retry-backoff sleep accrued WHILE holding this slot;
+                # _release_locked subtracts it from the hold-time EWMA
+                ticket.backoff_s = max(rt.backoff_s - backoff0, 0.0)
             self.release(ticket)
 
 

@@ -69,8 +69,16 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     # resilience observability
     "retries", "degradations", "deadline_exceeded",
     "fault_compile", "fault_materialize", "fault_stage_exec",
-    "fault_chunked_read", "fault_host_transfer", "fault_cache_populate",
-    "fault_admission",
+    "fault_stage_replay", "fault_chunked_read", "fault_host_transfer",
+    "fault_cache_populate", "fault_admission", "fault_drain",
+    # failure-domain recovery (stage replay + quarantine + watchdog):
+    # stage_execs counts stage-execution ATTEMPTS; stage_replays counts
+    # checkpointed re-executions of a single failed stage;
+    # stage_replay_saved_stages counts the already-materialized stages a
+    # replay did NOT have to re-run
+    "stage_execs", "stage_replays", "stage_replay_saved_stages",
+    "quarantine_skips", "quarantine_probes", "quarantine_marks",
+    "watchdog_trips",
     # workload manager (runtime/scheduler.py): per-class admission
     # outcomes; for any submission mix, admitted + rejected + timeout
     # always sums to the queries that entered admission
@@ -90,7 +98,7 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     "queries", "query_errors", "slow_queries",
     # server boundary
     "server_queries", "server_query_errors", "server_cancels",
-    "server_throttled",
+    "server_throttled", "server_drain_rejects",
 )
 
 STABLE_HISTOGRAMS: Tuple[str, ...] = (
@@ -104,6 +112,9 @@ STABLE_GAUGES: Tuple[str, ...] = (
     # workload manager: live queue depth (incl. server seats), queries
     # currently executing, and device bytes reserved by admitted queries
     "sched_queue_depth", "sched_running", "sched_reserved_bytes",
+    # 1 while the process is draining (SIGTERM/SIGINT received, in-flight
+    # queries finishing, new admissions refused), else 0
+    "server_draining",
 )
 
 # exponential-ish bucket bounds in milliseconds; histograms are BOUNDED by
@@ -443,7 +454,7 @@ def record_nodes():
 # splits the execute wall
 _PHASE_SPANS = ("parse", "plan", "execute", "fetch", "compile",
                 "materialize", "stage", "stage_graph", "stream_batch",
-                "queued")
+                "queued", "retry_backoff", "drain")
 
 
 class QueryReport:

@@ -16,6 +16,18 @@ Prometheus text format — the same counters previously only reachable via
 ``physical.compiled.stats`` — and per-query wire stats carry the query's
 phase breakdown from its QueryReport.
 
+**Graceful drain.**  SIGTERM/SIGINT (handlers installed by the blocking
+``run_server`` path; tests and embedders use ``server.drain_async()``)
+flips the workload manager into draining: new ``POST /v1/statement``
+requests answer **503 + Retry-After** (typed
+``resilience.ServerDraining``), in-flight queries finish — and their
+results stay fetchable — within ``DSQL_DRAIN_TIMEOUT_S``, stragglers get
+typed cancellation, then the listener closes and the process can exit.
+The ``server_draining`` gauge is 1 for the duration and the drain itself
+records a ``drain`` span in a QueryReport.  ``ERROR_WIRE_MATRIX`` below
+pins the full taxonomy → (submit-time HTTP status, errorType, errorName)
+mapping; tests assert it row by row.
+
 Built on stdlib http.server (FastAPI/uvicorn are not in this image); the wire
 format matches the reference's responses.py so presto/trino clients work.
 """
@@ -32,10 +44,48 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
-from ..runtime import (resilience as _res, scheduler as _sched,
-                       telemetry as _tel)
+from ..runtime import (faults as _faults, resilience as _res,
+                       scheduler as _sched, telemetry as _tel)
 
 logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# taxonomy -> wire mapping (audited; tests/unit/test_error_wire_matrix.py
+# asserts every row).  The submit-time status is what POST /v1/statement
+# answers when the verdict is known BEFORE a query id exists (admission /
+# drain); verdicts raised later ride the Presto convention — HTTP 200 with
+# a FAILED payload carrying errorType/errorName/errorCode — exactly like
+# the reference server.
+# ---------------------------------------------------------------------------
+
+ERROR_WIRE_MATRIX = {
+    # class name: (submit-time HTTP status, errorType, errorName)
+    "UserError": (200, "USER_ERROR", "GENERIC_USER_ERROR"),
+    "QueryCancelled": (200, "USER_ERROR", "USER_CANCELED"),
+    "TransientError": (200, "INTERNAL_ERROR", "TRANSIENT_ERROR"),
+    "FatalError": (200, "INTERNAL_ERROR", "GENERIC_INTERNAL_ERROR"),
+    "FaultInjected": (200, "INTERNAL_ERROR", "FAULT_INJECTED"),
+    "FatalFaultInjected": (200, "INTERNAL_ERROR", "FAULT_INJECTED"),
+    "DeadlineExceeded": (200, "INSUFFICIENT_RESOURCES",
+                         "EXCEEDED_TIME_LIMIT"),
+    "AdmissionRejected": (429, "INSUFFICIENT_RESOURCES", "QUERY_QUEUE_FULL"),
+    "AdmissionTimeout": (429, "INSUFFICIENT_RESOURCES",
+                         "QUERY_QUEUE_TIMEOUT"),
+    "ServerDraining": (503, "INSUFFICIENT_RESOURCES",
+                       "SERVER_SHUTTING_DOWN"),
+}
+
+
+def submit_status(exc: Exception) -> int:
+    """HTTP status for a verdict raised at the POST boundary: 503 while
+    draining, 429 on saturation, 200 otherwise (the error then travels in
+    the Presto payload)."""
+    if isinstance(exc, _res.ServerDraining):
+        return 503
+    if isinstance(exc, _res.AdmissionRejected):
+        return 429
+    return 200
 
 
 # ---------------------------------------------------------------------------
@@ -237,6 +287,98 @@ class _AppState:
         self.cancel_events: Dict[str, threading.Event] = {}
         self.seats: Dict[str, _sched.Seat] = {}
         self.lock = threading.Lock()
+        self.drained = threading.Event()     # set when a drain completed
+
+
+# ---------------------------------------------------------------------------
+# graceful drain (SIGTERM/SIGINT)
+# ---------------------------------------------------------------------------
+
+def _drain_and_shutdown(server, state: _AppState,
+                        reason: str = "drain") -> None:
+    """Drain this server, then stop it.
+
+    New admissions are refused the instant the workload manager flips to
+    draining (POST answers 503 + Retry-After); in-flight queries finish —
+    and their results stay fetchable, the status poll deletes a query's
+    entry only once the client collected it — within
+    ``DSQL_DRAIN_TIMEOUT_S``.  Stragglers past the budget get TYPED
+    cancellation (``QueryCancelled`` at their next checkpoint), never an
+    abandoned thread.  The whole procedure runs under a ``drain`` span so
+    the shutdown leaves a QueryReport behind, and it is itself a fault
+    site (``drain``, runtime/faults.py) — an injected fault there is
+    swallowed, because a broken drain step must never wedge process exit.
+    """
+    mgr = _sched.get_manager()
+    timeout = _sched.drain_timeout_s()
+    mgr.begin_drain()
+    logger.warning("%s: draining server (timeout %.0f s, %d in flight)",
+                   reason, timeout, len(state.future_list))
+    try:
+        with _tel.trace_scope(f"<drain:{reason}>"):
+            with _tel.span("drain", reason=reason, timeout_s=timeout):
+                try:
+                    _faults.maybe_fail("drain")
+                except Exception as e:
+                    logger.warning(
+                        "injected drain fault (%s); continuing shutdown", e)
+                deadline = time.monotonic() + timeout
+                while state.future_list and time.monotonic() < deadline:
+                    time.sleep(0.05)
+                stragglers = list(state.future_list.keys())
+                if stragglers:
+                    _tel.annotate(cancelled=len(stragglers))
+                    logger.warning(
+                        "drain timeout: typed-cancelling %d in-flight "
+                        "quer%s", len(stragglers),
+                        "y" if len(stragglers) == 1 else "ies")
+                    for ev in list(state.cancel_events.values()):
+                        ev.set()
+                    grace = time.monotonic() + 2.0
+                    while (any(not f.done()
+                               for f in list(state.future_list.values()))
+                           and time.monotonic() < grace):
+                        time.sleep(0.05)
+    finally:
+        try:
+            server.shutdown()
+            server.server_close()
+        except Exception:
+            logger.exception("server shutdown failed during drain")
+        state.pool.shutdown(wait=False, cancel_futures=True)
+        # reset the process-global flag: in production the process exits
+        # right after; in tests this restores the shared manager
+        mgr.end_drain()
+        state.drained.set()
+        logger.warning("drain complete; server stopped")
+
+
+def install_drain_handlers(server) -> dict:
+    """Install SIGTERM/SIGINT handlers that drain ``server`` gracefully.
+
+    Only possible from the main thread (a ``signal`` module restriction);
+    returns the previous handlers so a caller (tests) can restore them, or
+    ``{}`` when installation was not possible.  The handler itself only
+    SPAWNS the drain thread — signal context must stay non-blocking."""
+    import signal
+
+    state = server.app_state
+
+    def handler(signum, frame):
+        threading.Thread(
+            target=_drain_and_shutdown,
+            args=(server, state, signal.Signals(signum).name),
+            daemon=True).start()
+
+    prev: dict = {}
+    try:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev[sig] = signal.signal(sig, handler)
+    except ValueError:
+        logger.debug("not the main thread; drain signal handlers not "
+                     "installed (use server.drain_async())")
+        return {}
+    return prev
 
 
 def _make_handler(state: _AppState, base_url: str):
@@ -324,6 +466,23 @@ def _make_handler(state: _AppState, base_url: str):
             sql = self.rfile.read(length).decode()
             _tel.inc("server_queries")
             uid = str(uuid_mod.uuid4())
+            mgr = _sched.get_manager()
+
+            def reject(e: _res.AdmissionRejected) -> None:
+                self._send(submit_status(e), _error_payload(str(e), uid,
+                                                            exc=e),
+                           headers={"Retry-After":
+                                    str(max(int(math.ceil(e.retry_after_s)),
+                                            1))})
+
+            # drain gate first (independent of the scheduler subsystem
+            # being enabled): a draining process refuses new work with 503
+            # so the load balancer retries elsewhere, while GET/DELETE keep
+            # serving in-flight queries to completion
+            if mgr.draining():
+                _tel.inc("server_drain_rejects")
+                reject(mgr._drain_verdict())
+                return
             # admission pre-claim at POST time: when every slot AND queue
             # position is taken the client gets an immediate 429 with a
             # Retry-After hint, instead of the query disappearing into an
@@ -331,13 +490,12 @@ def _make_handler(state: _AppState, base_url: str):
             priority = _sched.normalize_priority(
                 self.headers.get("X-DSQL-Priority"))
             try:
-                seat = _sched.get_manager().claim_seat(priority)
+                seat = mgr.claim_seat(priority)
             except _res.AdmissionRejected as e:
-                _tel.inc("server_throttled")
-                self._send(429, _error_payload(str(e), uid, exc=e),
-                           headers={"Retry-After":
-                                    str(max(int(math.ceil(e.retry_after_s)),
-                                            1))})
+                _tel.inc("server_drain_rejects"
+                         if isinstance(e, _res.ServerDraining)
+                         else "server_throttled")
+                reject(e)
                 return
             info = _QueryInfo()
             cancel = threading.Event()
@@ -450,11 +608,19 @@ def run_server(context=None, host: str = "0.0.0.0", port: int = 8080,
     base_url = f"http://{host}:{server.server_port}"
     server.RequestHandlerClass = _make_handler(state, base_url)
     server.app_state = state
+    # drain surface for embedders/tests (the signal handlers below call
+    # the same procedure): returns immediately; state.drained (also
+    # exposed as server.drained_event) is set when the drain completed
+    server.drain_async = lambda reason="drain": threading.Thread(
+        target=_drain_and_shutdown, args=(server, state, reason),
+        daemon=True).start()
+    server.drained_event = state.drained
     context.server = server
     if not blocking:
         t = threading.Thread(target=server.serve_forever, daemon=True)
         t.start()
         return server
+    install_drain_handlers(server)
     try:
         logger.info("dask-sql-tpu server listening on %s", base_url)
         server.serve_forever()

@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""Chaos-soak gate: randomized faults under concurrent mixed-priority load.
+
+The resilience suites prove each failure path in isolation; production
+breaks at the COMPOSITION — a stage replay racing an admission rejection
+racing a cache-populate fault.  This harness is the continuous rehearsal
+the ROADMAP's serving story needs (run by scripts/ci_local.sh as
+``python scripts/chaos_soak.py --budget-s 45``; the long variant rides the
+``slow`` pytest marker in tests/integration/test_chaos_soak.py):
+
+  * ``--clients`` concurrent client threads (default 4) submit random
+    queries from a fixed menu (agg / join+agg / filter+topk / global agg /
+    chunked streaming) at random priorities through the armed workload
+    manager (2 slots) for ``--budget-s`` seconds;
+  * EVERY injection site (runtime/faults.py SITES) is armed
+    probabilistically at ``--p`` (default 0.05) with per-site seeds, plus
+    a rarer FATAL compile fault that exercises the exile + quarantine
+    paths (a temp ``DSQL_QUARANTINE_FILE`` is armed);
+  * every successful result is checked against a precomputed pandas
+    oracle.
+
+Engine-wide invariants asserted at the end — the acceptance bar:
+
+  1. ZERO wrong results (a fault may slow or fail a query, never corrupt
+     one);
+  2. ZERO lost/hung queries: every submission reaches a terminal outcome
+     (result or typed ResilienceError) and every client thread joins;
+  3. ZERO untyped failures escaping the engine;
+  4. counters reconcile: admitted + rejected + timeout + injected
+     admission faults == submissions, and the scheduler ends with no
+     running slots or queue ghosts;
+  5. the engine is healthy AFTER the soak: with faults disarmed, every
+     menu query answers oracle-correct.
+
+Exit 0 on success.
+"""
+import argparse
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("DSQL_MAX_CONCURRENT_QUERIES", "2")
+os.environ.setdefault("DSQL_QUEUE_DEPTH", "64")
+os.environ.setdefault("DSQL_QUEUE_TIMEOUT_MS", "120000")
+os.environ.setdefault("DSQL_RETRY_BASE_MS", "1")
+# stage every multi-heavy plan so the stage-exec/stage-replay failure
+# domain is actually in play on the small soak queries
+os.environ.setdefault("DSQL_STAGE_HEAVY", "1")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+
+N_ROWS = 2000
+PRIORITIES = ("interactive", "batch", "background")
+QUERY_TIMEOUT_S = 30.0
+JOIN_GRACE_S = 90.0
+
+
+def _norm(df: pd.DataFrame) -> pd.DataFrame:
+    out = df.copy()
+    for col in out.columns:
+        if out[col].dtype.kind in "iuf":
+            out[col] = out[col].astype("float64").round(6)
+    return (out.sort_values(list(out.columns), na_position="last")
+               .reset_index(drop=True))
+
+
+def _make_data(seed: int):
+    rng = np.random.default_rng(seed)
+    t1 = pd.DataFrame({
+        "k": rng.integers(0, 20, N_ROWS),
+        "v": np.round(rng.random(N_ROWS) * 10, 3),
+        "w": rng.integers(0, 100, N_ROWS),
+    })
+    t2 = pd.DataFrame({
+        "k": rng.integers(0, 20, N_ROWS // 2),
+        "c": np.round(rng.random(N_ROWS // 2) * 5, 3),
+    })
+    return t1, t2
+
+
+def _menu(t1: pd.DataFrame, t2: pd.DataFrame):
+    """[(sql, pandas-oracle DataFrame)]: fixed queries, oracles computed
+    once up front so the soak loop never consults the engine under test.
+    Literal VARIANTS give distinct plan fingerprints, so the soak keeps
+    compiling and executing fresh programs instead of collapsing into
+    result-cache hits (which stay in the mix too — repeats are real
+    traffic)."""
+    j = t1.merge(t2, on="k")
+    menu = [
+        ("SELECT k, SUM(v) AS s, COUNT(*) AS n FROM t1 GROUP BY k",
+         t1.groupby("k", as_index=False).agg(s=("v", "sum"),
+                                             n=("v", "size"))),
+        ("SELECT t1.k AS k, SUM(t2.c) AS s FROM t1 "
+         "JOIN t2 ON t1.k = t2.k GROUP BY t1.k",
+         j.groupby("k", as_index=False).agg(s=("c", "sum"))),
+        ("SELECT SUM(v) AS s, MIN(w) AS mn, MAX(w) AS mx FROM t1",
+         pd.DataFrame({"s": [t1.v.sum()], "mn": [t1.w.min()],
+                       "mx": [t1.w.max()]})),
+        ("SELECT k, SUM(v) AS s FROM tc GROUP BY k",
+         t1.groupby("k", as_index=False).agg(s=("v", "sum"))),
+    ]
+    for x in (2, 4, 6, 8):
+        sql = (f"SELECT k, v FROM t1 WHERE v > {x}.0 "
+               "ORDER BY v DESC, k LIMIT 50")
+        menu.append((sql, t1[t1.v > float(x)]
+                     .sort_values(["v", "k"], ascending=[False, True])
+                     [["k", "v"]].head(50)))
+        sql = (f"SELECT t1.k AS k, SUM(t2.c) AS s FROM t1 "
+               f"JOIN t2 ON t1.k = t2.k WHERE t1.w < {x * 12} "
+               "GROUP BY t1.k")
+        jw = j[j.w < x * 12]
+        menu.append((sql, jw.groupby("k", as_index=False)
+                     .agg(s=("c", "sum"))))
+    return menu
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--budget-s", type=float, default=45.0)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--p", type=float, default=0.05)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    qdir = tempfile.mkdtemp(prefix="dsql_chaos_")
+    os.environ["DSQL_QUARANTINE_FILE"] = os.path.join(qdir, "quarantine.json")
+    os.environ["DSQL_QUARANTINE_TTL_S"] = "5"      # let probes happen in-soak
+
+    # probabilistic faults on EVERY site, deterministic per-site streams,
+    # plus a rare FATAL compile fault (exile + quarantine coverage)
+    from dask_sql_tpu.runtime import faults
+    spec = ",".join(f"{s}:p={args.p}:seed={args.seed + i}"
+                    for i, s in enumerate(faults.SITES))
+    spec += f",compile:p={args.p / 5:.4f}:seed={args.seed + 100}:fatal"
+    os.environ["DSQL_FAULT_INJECT"] = spec
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.runtime import resilience as res
+    from dask_sql_tpu.runtime import scheduler as sched
+    from dask_sql_tpu.runtime import telemetry as tel
+
+    t1, t2 = _make_data(args.seed)
+    ctx = Context()
+    ctx.create_table("t1", t1)
+    ctx.create_table("t2", t2)
+    # chunked registration exercises the streaming sites
+    ctx.create_table("tc", t1, chunked=True, batch_rows=512)
+    menu = _menu(t1, t2)
+
+    c0 = tel.REGISTRY.counters()
+    lock = threading.Lock()
+    stats = {"submitted": 0, "ok": 0, "typed": 0, "untyped": 0, "wrong": 0}
+    problems = []
+
+    t_end = time.monotonic() + args.budget_s
+
+    def client(tid: int) -> None:
+        rng = random.Random(args.seed * 1000 + tid)
+        while time.monotonic() < t_end:
+            sql, oracle = menu[rng.randrange(len(menu))]
+            pr = PRIORITIES[rng.randrange(len(PRIORITIES))]
+            with lock:
+                stats["submitted"] += 1
+            try:
+                got = ctx.sql(sql, return_futures=False,
+                              timeout=QUERY_TIMEOUT_S, priority=pr)
+            except res.ResilienceError:
+                with lock:
+                    stats["typed"] += 1
+                continue
+            except Exception as e:  # noqa: BLE001 - the gate records it
+                with lock:
+                    stats["untyped"] += 1
+                    problems.append(f"untyped {type(e).__name__} on "
+                                    f"{sql!r}: {e}")
+                continue
+            try:
+                pd.testing.assert_frame_equal(
+                    _norm(got), _norm(oracle), check_dtype=False,
+                    rtol=1e-6, atol=1e-9)
+            except AssertionError as e:
+                with lock:
+                    stats["wrong"] += 1
+                    problems.append(f"WRONG RESULT on {sql!r}: "
+                                    f"{str(e)[:300]}")
+                continue
+            with lock:
+                stats["ok"] += 1
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    for th in threads:
+        th.start()
+    hung = 0
+    join_by = time.monotonic() + args.budget_s + JOIN_GRACE_S
+    for th in threads:
+        th.join(timeout=max(join_by - time.monotonic(), 0.1))
+        if th.is_alive():
+            hung += 1
+
+    c1 = tel.REGISTRY.counters()
+
+    def d(name: str) -> int:
+        return c1.get(name, 0) - c0.get(name, 0)
+
+    failures = list(problems)
+    if hung:
+        failures.append(f"{hung} client thread(s) hung past the "
+                        f"{JOIN_GRACE_S:.0f} s grace — lost queries")
+    if stats["wrong"]:
+        failures.append(f"{stats['wrong']} wrong result(s)")
+    if stats["untyped"]:
+        failures.append(f"{stats['untyped']} untyped failure(s) escaped "
+                        "the taxonomy")
+    if stats["ok"] + stats["typed"] + stats["untyped"] + stats["wrong"] \
+            != stats["submitted"]:
+        failures.append("outcome counts do not sum to submissions")
+    if stats["ok"] == 0:
+        failures.append("no query succeeded — the soak proved nothing")
+
+    # scheduler reconciliation: every submission enters admission exactly
+    # once and leaves as admitted | rejected | timeout | injected fault
+    mgr = sched.get_manager()
+    admitted = sum(d(f"sched_admitted_{p}") for p in PRIORITIES)
+    rejected = sum(d(f"sched_rejected_{p}") for p in PRIORITIES)
+    timeout = sum(d(f"sched_timeout_{p}") for p in PRIORITIES)
+    adm_faults = d("fault_admission")
+    accounted = admitted + rejected + timeout + adm_faults
+    if accounted != stats["submitted"]:
+        failures.append(
+            f"admission counters do not reconcile: admitted {admitted} + "
+            f"rejected {rejected} + timeout {timeout} + injected "
+            f"{adm_faults} = {accounted} != submitted {stats['submitted']}")
+    if mgr.running_count() != 0 or mgr.queue_depth() != 0:
+        failures.append(
+            f"scheduler leaked state: running={mgr.running_count()} "
+            f"queued={mgr.queue_depth()} after the soak")
+
+    # post-soak health: faults disarmed, every menu query oracle-correct
+    os.environ.pop("DSQL_FAULT_INJECT", None)
+    faults.reset()
+    for sql, oracle in menu:
+        try:
+            got = ctx.sql(sql, return_futures=False, timeout=QUERY_TIMEOUT_S)
+            pd.testing.assert_frame_equal(
+                _norm(got), _norm(oracle), check_dtype=False,
+                rtol=1e-6, atol=1e-9)
+        except Exception as e:  # noqa: BLE001 - the gate records it
+            failures.append(f"post-soak health check failed on {sql!r}: "
+                            f"{type(e).__name__}: {str(e)[:200]}")
+
+    interesting = ("retries", "degradations", "stage_replays",
+                   "stage_replay_saved_stages", "stage_execs",
+                   "quarantine_skips", "quarantine_probes",
+                   "quarantine_marks", "exiled", "deadline_exceeded",
+                   "result_cache_hits")
+    fault_counts = {k: d(k) for k in c1 if k.startswith("fault_") and d(k)}
+    print(f"chaos soak: {stats['submitted']} submitted over "
+          f"{args.budget_s:.0f} s x {args.clients} clients (p={args.p}) -> "
+          f"{stats['ok']} ok, {stats['typed']} typed failures, "
+          f"{stats['wrong']} wrong, {stats['untyped']} untyped, "
+          f"{hung} hung")
+    print("  admission: "
+          f"admitted={admitted} rejected={rejected} timeout={timeout} "
+          f"injected={adm_faults}")
+    print("  faults fired: " + (", ".join(
+        f"{k[len('fault_'):]}={v}" for k, v in sorted(fault_counts.items()))
+        or "none"))
+    print("  recovery: " + ", ".join(
+        f"{k}={d(k)}" for k in interesting if d(k)))
+
+    if failures:
+        print("CHAOS SOAK FAILED:")
+        for f in failures:
+            print("  - " + f)
+        return 1
+    print("chaos soak OK: zero wrong results, zero lost queries, "
+          "counters reconcile")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,12 @@ echo "=== [2e] scheduler smoke (workload manager) ==="
 # and DSQL_MAX_CONCURRENT_QUERIES=0 restores pre-subsystem behavior
 python scripts/sched_smoke.py
 
+echo "=== [2f] chaos soak (failure-domain recovery) ==="
+# 45 s of randomized probabilistic faults (p=0.05, every site) under 4
+# concurrent mixed-priority clients: zero wrong results, zero lost/hung
+# queries, admission counters reconcile, engine healthy afterwards
+python scripts/chaos_soak.py --budget-s 45
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \

@@ -101,6 +101,21 @@ def _scheduler_off(request, monkeypatch):
     yield
 
 
+@pytest.fixture(autouse=True)
+def _quarantine_off(request, monkeypatch):
+    """The cross-process quarantine store + compile watchdog
+    (runtime/quarantine.py) are file/env-armed; an operator's environment
+    must not leak verdicts into unrelated suites.  Mirroring the cache and
+    scheduler pins: off by default, armed explicitly by the dedicated
+    quarantine/failure-domain/drain suites."""
+    name = request.module.__name__
+    if ("quarantine" not in name and "failure" not in name
+            and "drain" not in name and "chaos" not in name):
+        monkeypatch.delenv("DSQL_QUARANTINE_FILE", raising=False)
+        monkeypatch.delenv("DSQL_COMPILE_WATCHDOG_S", raising=False)
+    yield
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bounded_executable_lifetime():
     yield

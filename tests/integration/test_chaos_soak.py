@@ -1,0 +1,24 @@
+"""Long chaos-soak variant (the 45 s CI gate lives in scripts/ci_local.sh;
+this is the extended rehearsal, excluded from tier-1 via the ``slow``
+marker).  Runs in a subprocess so the soak's env arming (scheduler slots,
+fault probabilities, quarantine file) can never leak into the suite."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.join(os.path.dirname(__file__), "..", "..")
+
+
+@pytest.mark.slow
+def test_chaos_soak_long():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DSQL_FAULT_INJECT", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "scripts", "chaos_soak.py"),
+         "--budget-s", "120", "--clients", "6", "--p", "0.08"],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (
+        f"long chaos soak failed:\n{proc.stdout[-4000:]}\n"
+        f"{proc.stderr[-4000:]}")

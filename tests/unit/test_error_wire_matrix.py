@@ -1,0 +1,75 @@
+"""The audited error -> wire mapping (server/app.py ERROR_WIRE_MATRIX):
+every ResilienceError subclass must yield a STABLE submit-time HTTP
+status, errorType and errorName — clients and load balancers key retry
+policy on these, so a drifting name is a breaking change exactly like a
+renamed metric."""
+import pytest
+
+from dask_sql_tpu.runtime import faults as F
+from dask_sql_tpu.runtime import resilience as R
+from dask_sql_tpu.server import app
+
+
+def _instance(name: str):
+    if name == "FaultInjected":
+        return F.FaultInjected("compile", 1)
+    if name == "FatalFaultInjected":
+        return F.FatalFaultInjected("compile", 1)
+    return getattr(R, name)("boom")
+
+
+@pytest.mark.parametrize("name,expected",
+                         sorted(app.ERROR_WIRE_MATRIX.items()))
+def test_wire_matrix_row(name, expected):
+    status, error_type, error_name = expected
+    exc = _instance(name)
+    assert app.submit_status(exc) == status
+    payload = app._error_payload(str(exc), "uid-1", exc=exc)
+    err = payload["error"]
+    assert err["errorType"] == error_type, name
+    assert err["errorName"] == error_name, name
+    assert err["errorCode"] == exc.error_code, name
+    assert payload["stats"]["state"] == "FAILED"
+
+
+def test_matrix_covers_every_taxonomy_class():
+    """A NEW ResilienceError subclass must either join the audited matrix
+    or inherit a mapped ancestor's wire identity UNCHANGED (e.g.
+    streaming's StreamingUnsupported is a plain UserError on the wire) —
+    silently drifting errorType/errorName is a breaking change."""
+    mapped = set(app.ERROR_WIRE_MATRIX)
+    for cls in _walk(R.ResilienceError):
+        if cls is R.ResilienceError or cls.__name__ in mapped:
+            continue
+        anc = next((a for a in cls.__mro__[1:] if a.__name__ in mapped),
+                   None)
+        assert anc is not None, f"unmapped taxonomy class {cls.__name__}"
+        for attr in ("error_type", "error_name", "error_code"):
+            assert getattr(cls, attr) == getattr(anc, attr), (
+                f"{cls.__name__} overrides {attr} but is not in "
+                f"ERROR_WIRE_MATRIX")
+
+
+def _walk(cls):
+    yield cls
+    for sub in cls.__subclasses__():
+        yield from _walk(sub)
+
+
+def test_oom_transient_keeps_memory_limit_name():
+    """TransientError(kind='oom') is the one taxonomy member whose wire
+    identity depends on a constructor argument; pin it separately."""
+    exc = R.TransientError("oom", kind="oom")
+    err = app._error_payload("x", "u", exc=exc)["error"]
+    assert err["errorType"] == "INSUFFICIENT_RESOURCES"
+    assert err["errorName"] == "EXCEEDED_MEMORY_LIMIT"
+    assert app.submit_status(exc) == 200
+
+
+def test_retry_after_header_sources():
+    """429/503 verdicts carry a usable Retry-After seed."""
+    assert R.AdmissionRejected("x", retry_after_s=2.5).retry_after_s == 2.5
+    assert R.ServerDraining("x", retry_after_s=30).retry_after_s == 30
+    # ServerDraining is an AdmissionRejected: anything handling the 429
+    # family (seat release, retry hints) handles draining for free
+    assert issubclass(R.ServerDraining, R.AdmissionRejected)

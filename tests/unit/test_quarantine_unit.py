@@ -1,0 +1,214 @@
+"""Quarantine store + compile watchdog (runtime/quarantine.py): verdict
+roundtrip, expiry, half-open probe semantics, corrupt-file tolerance,
+cross-"process" sharing (two store instances over one file), and the
+watchdog's suspect-mark/lift lifecycle."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from dask_sql_tpu.runtime import quarantine as Q
+from dask_sql_tpu.runtime import telemetry as tel
+
+
+@pytest.fixture()
+def store(tmp_path, monkeypatch):
+    path = str(tmp_path / "quarantine.json")
+    monkeypatch.setenv("DSQL_QUARANTINE_FILE", path)
+    monkeypatch.setenv("DSQL_QUARANTINE_TTL_S", "3600")
+    monkeypatch.setenv("DSQL_QUARANTINE_PROBE_S", "3600")
+    return Q.QuarantineStore(path)
+
+
+def test_disabled_without_file(monkeypatch):
+    monkeypatch.delenv("DSQL_QUARANTINE_FILE", raising=False)
+    s = Q.QuarantineStore()
+    assert not s.enabled()
+    assert s.check("k") is None
+    s.mark("k", "fatal")              # silent no-op
+    assert s.check("k") is None
+
+
+def test_mark_check_clear_roundtrip(store):
+    assert store.check("k1") is None
+    store.mark("k1", "fatal", reason="boom")
+    assert store.check("k1") == "quarantined"
+    entry = store.entries()["k1"]
+    assert entry["verdict"] == "fatal"
+    assert entry["reason"] == "boom"
+    assert entry["strikes"] == 1
+    store.clear("k1")
+    assert store.check("k1") is None
+    # clearing an absent key is a no-op
+    store.clear("k1")
+
+
+def test_remark_counts_strikes(store):
+    store.mark("k", "hang")
+    store.mark("k", "fatal")
+    assert store.entries()["k"]["strikes"] == 2
+    assert store.entries()["k"]["verdict"] == "fatal"
+
+
+def test_expiry_then_half_open_probe(store, monkeypatch):
+    monkeypatch.setenv("DSQL_QUARANTINE_TTL_S", "0.05")
+    monkeypatch.setenv("DSQL_QUARANTINE_PROBE_S", "3600")
+    store.mark("k", "fatal")
+    assert store.check("k") == "quarantined"
+    time.sleep(0.08)
+    # expired: exactly ONE caller gets the probe; the entry is re-armed
+    # for the probe window so every other caller keeps skipping
+    assert store.check("k") == "probe"
+    assert store.check("k") == "quarantined"
+    # a successful probe lifts the verdict entirely
+    store.clear("k")
+    assert store.check("k") is None
+
+
+def test_failed_probe_rearms_full_ttl(store, monkeypatch):
+    monkeypatch.setenv("DSQL_QUARANTINE_TTL_S", "0.05")
+    store.mark("k", "hang")
+    time.sleep(0.08)
+    assert store.check("k") == "probe"
+    # the probe compile failed again: mark() re-arms with a full TTL
+    monkeypatch.setenv("DSQL_QUARANTINE_TTL_S", "3600")
+    store.mark("k", "fatal", reason="probe failed")
+    assert store.check("k") == "quarantined"
+    assert store.entries()["k"]["strikes"] == 2
+
+
+def test_corrupt_file_reads_as_empty(store):
+    store.mark("k", "fatal")
+    with open(store.path(), "w") as f:
+        f.write("{ this is not json")
+    assert store.check("k") is None          # tolerated, not raised
+    # and the store still accepts new marks (overwrites the junk)
+    store.mark("k2", "hang")
+    assert store.check("k2") == "quarantined"
+    with open(store.path()) as f:
+        assert json.load(f)["k2"]["verdict"] == "hang"
+
+
+def test_non_dict_entries_are_ignored(store):
+    with open(store.path(), "w") as f:
+        json.dump({"bad": 17, "ok": {"verdict": "fatal",
+                                     "expires_at": time.time() + 60}}, f)
+    assert store.check("bad") is None
+    assert store.check("ok") == "quarantined"
+
+
+def test_two_stores_share_one_file(tmp_path, monkeypatch):
+    """The cross-process contract, modeled as two independent store
+    instances (each with its own mtime cache) over one file."""
+    path = str(tmp_path / "q.json")
+    monkeypatch.setenv("DSQL_QUARANTINE_TTL_S", "3600")
+    a = Q.QuarantineStore(path)
+    b = Q.QuarantineStore(path)
+    a.mark("k", "fatal", reason="process A crashed")
+    assert b.check("k") == "quarantined"
+    b.clear("k")
+    assert a.check("k") is None
+
+
+def test_program_key_folds_device_fingerprint():
+    k1 = Q.program_key(("plan", "inputs", True))
+    k2 = Q.program_key(("plan", "inputs", False))
+    assert k1 != k2
+    assert k1 == Q.program_key(("plan", "inputs", True))
+
+
+# ---------------------------------------------------------------------------
+# compile watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_noop_when_disarmed(monkeypatch):
+    monkeypatch.delenv("DSQL_COMPILE_WATCHDOG_S", raising=False)
+    wd = Q.CompileWatchdog()
+    with wd.watch("k"):
+        pass
+    assert not wd._entries
+
+
+def test_watchdog_marks_wedged_section_suspect(store, monkeypatch):
+    """A section that exceeds the wall budget gets its fingerprint marked
+    'hang' WHILE still running — the cross-process record a killed/wedged
+    process leaves behind."""
+    monkeypatch.setenv("DSQL_COMPILE_WATCHDOG_S", "0.15")
+    wd = Q.CompileWatchdog()
+    t0 = tel.REGISTRY.get("watchdog_trips")
+    marked_mid_flight = []
+    try:
+        with wd.watch("wedged", label="test-compile"):
+            deadline = time.time() + 5
+            while not marked_mid_flight and time.time() < deadline:
+                if store.check("wedged") is not None:
+                    marked_mid_flight.append(store.entries()["wedged"])
+                time.sleep(0.02)
+            raise RuntimeError("compile crashed after the hang")
+    except RuntimeError:
+        pass
+    assert marked_mid_flight, "watchdog never marked the wedged section"
+    assert marked_mid_flight[0]["verdict"] == "hang"
+    assert tel.REGISTRY.get("watchdog_trips") > t0
+    # the exception exit leaves the mark in place
+    assert store.check("wedged") == "quarantined"
+
+
+def test_watchdog_clean_finish_lifts_suspect_mark(store, monkeypatch):
+    monkeypatch.setenv("DSQL_COMPILE_WATCHDOG_S", "0.1")
+    wd = Q.CompileWatchdog()
+    with wd.watch("slow", label="slow-but-fine"):
+        deadline = time.time() + 5
+        while store.check("slow") is None and time.time() < deadline:
+            time.sleep(0.02)
+        assert store.check("slow") is not None
+    # finished cleanly: the verdict meant "wedged", not "slow" — lifted
+    assert store.check("slow") is None
+
+
+def test_watchdog_fast_section_never_marked(store, monkeypatch):
+    monkeypatch.setenv("DSQL_COMPILE_WATCHDOG_S", "5")
+    wd = Q.CompileWatchdog()
+    with wd.watch("fast"):
+        time.sleep(0.01)
+    time.sleep(0.15)                  # give the monitor a poll cycle
+    assert store.check("fast") is None
+
+
+def test_watchdog_concurrent_sections_independent(store, monkeypatch):
+    monkeypatch.setenv("DSQL_COMPILE_WATCHDOG_S", "0.15")
+    wd = Q.CompileWatchdog()
+    done = threading.Event()
+
+    def fast():
+        with wd.watch("fast2"):
+            time.sleep(0.01)
+        done.set()
+
+    t = threading.Thread(target=fast)
+    with wd.watch("slow2"):
+        t.start()
+        t.join(timeout=5)
+        deadline = time.time() + 5
+        while store.check("slow2") is None and time.time() < deadline:
+            time.sleep(0.02)
+        raise_late = store.check("slow2")
+    assert done.is_set()
+    assert raise_late is not None
+    assert store.check("fast2") is None
+
+
+# ---------------------------------------------------------------------------
+# stable-name contract additions
+# ---------------------------------------------------------------------------
+
+def test_quarantine_names_in_stable_contract():
+    for name in ("stage_execs", "stage_replays",
+                 "stage_replay_saved_stages", "quarantine_skips",
+                 "quarantine_probes", "quarantine_marks", "watchdog_trips",
+                 "fault_stage_replay", "fault_drain",
+                 "server_drain_rejects"):
+        assert name in tel.STABLE_COUNTERS
+    assert "server_draining" in tel.STABLE_GAUGES

@@ -245,3 +245,78 @@ def test_env_spec_is_read_per_call(monkeypatch):
         faults.maybe_fail("materialize")
     monkeypatch.delenv("DSQL_FAULT_INJECT")
     faults.maybe_fail("materialize")
+
+
+# ---------------------------------------------------------------------------
+# probabilistic arming + fatal action (the chaos-soak spec forms)
+# ---------------------------------------------------------------------------
+
+def test_parse_probabilistic_and_fatal_spec():
+    specs = faults.parse_spec(
+        "compile:p=0.25:seed=7,stage_replay:1,drain:1,compile:2:fatal")
+    assert specs[0].prob == 0.25 and specs[0].rng is not None
+    assert specs[0].nth is None
+    assert [s.site for s in specs[1:3]] == ["stage_replay", "drain"]
+    assert specs[3].fatal
+    with pytest.raises(ValueError):
+        faults.parse_spec("compile:p=0")          # outside (0, 1]
+    with pytest.raises(ValueError):
+        faults.parse_spec("compile:p=1.5")
+
+
+def test_probabilistic_fire_rate_is_seeded_and_deterministic():
+    def fires(spec):
+        out = []
+        with faults.inject(spec):
+            for i in range(200):
+                try:
+                    faults.maybe_fail("compile")
+                    out.append(False)
+                except faults.FaultInjected:
+                    out.append(True)
+        return out
+
+    a = fires("compile:p=0.2:seed=11")
+    b = fires("compile:p=0.2:seed=11")
+    assert a == b, "same seed must reproduce the same fault sequence"
+    rate = sum(a) / len(a)
+    assert 0.05 < rate < 0.45, f"p=0.2 spec fired at {rate}"
+    c = fires("compile:p=0.2:seed=12")
+    assert a != c, "different seeds should diverge"
+
+
+def test_fatal_action_raises_fatal_typed():
+    before = compiled.stats["fault_compile"]
+    with faults.inject("compile:1:fatal"):
+        with pytest.raises(faults.FatalFaultInjected) as ei:
+            faults.maybe_fail("compile")
+    assert isinstance(ei.value, R.FatalError)
+    assert not isinstance(ei.value, R.TransientError)
+    assert compiled.stats["fault_compile"] == before + 1
+
+
+def test_new_sites_registered():
+    for site in ("stage_replay", "drain"):
+        assert site in faults.SITES
+
+
+# ---------------------------------------------------------------------------
+# retry-backoff accounting (feeds the scheduler's honest hold-time EWMA)
+# ---------------------------------------------------------------------------
+
+def test_backoff_accrues_on_runtime(monkeypatch):
+    monkeypatch.setenv("DSQL_RETRY_BASE_MS", "30")
+    with R.query_scope() as rt:
+        assert rt.backoff_s == 0.0
+        R.backoff(1, "t")
+        assert rt.backoff_s >= 0.025
+        R.backoff(1, "t")
+        assert rt.backoff_s >= 0.05
+
+
+def test_backoff_accrual_survives_deadline_cut(monkeypatch):
+    monkeypatch.setenv("DSQL_RETRY_BASE_MS", "400")
+    with R.query_scope(timeout_s=0.05) as rt:
+        with pytest.raises(R.DeadlineExceeded):
+            R.backoff(1, "t")      # budget cannot cover: raises pre-sleep
+        assert rt.backoff_s == 0.0

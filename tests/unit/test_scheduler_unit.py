@@ -455,3 +455,112 @@ def test_gauges_track_queue_and_running(mgr):
     assert tel.REGISTRY.get_gauge("sched_running") == 1
     mgr.release(t)
     assert tel.REGISTRY.get_gauge("sched_running") == 0
+
+
+# ---------------------------------------------------------------------------
+# honest hold-time EWMA: retry/backoff sleep must not inflate the
+# queue-wait estimate (and thereby trigger spurious deadline fast-rejects)
+# ---------------------------------------------------------------------------
+
+def test_release_subtracts_recorded_backoff(mgr):
+    t = mgr.acquire("interactive", 0)
+    time.sleep(0.05)
+    # pretend nearly the whole hold was retry-backoff sleep
+    t.backoff_s = 10.0
+    mgr.release(t)
+    assert mgr._run_ewma_s is not None
+    assert mgr._run_ewma_s < 0.05, (
+        f"EWMA {mgr._run_ewma_s} still counts backoff sleep")
+
+
+def test_admission_threads_runtime_backoff_into_ewma(mgr, monkeypatch):
+    """End-to-end through the real path: an in-rung retry backoff inside
+    an admitted query's scope is recorded on the QueryRuntime
+    (resilience.backoff) and subtracted at release."""
+    monkeypatch.setenv("DSQL_RETRY_BASE_MS", "150")
+    with res.query_scope():
+        with mgr.admission(priority="interactive") as t:
+            assert t is not None
+            res.backoff(1, "test-site")       # ~150 ms asleep in the slot
+    assert mgr._run_ewma_s is not None
+    assert mgr._run_ewma_s < 0.1, (
+        f"EWMA {mgr._run_ewma_s} inflated by retry backoff")
+
+
+def test_backoff_outside_admission_does_not_leak(mgr, monkeypatch):
+    """Backoff spent BEFORE admission (e.g. while a previous statement of
+    the same query retried) must not be charged to this slot."""
+    monkeypatch.setenv("DSQL_RETRY_BASE_MS", "80")
+    with res.query_scope():
+        res.backoff(1, "pre-admission")
+        with mgr.admission(priority="batch") as t:
+            time.sleep(0.05)
+            assert t is not None
+    # hold was ~50 ms of real work; pre-admission backoff not subtracted
+    assert 0.02 < mgr._run_ewma_s < 0.5
+
+
+# ---------------------------------------------------------------------------
+# drain mode
+# ---------------------------------------------------------------------------
+
+def test_drain_rejects_new_admissions_typed(mgr):
+    mgr.begin_drain()
+    try:
+        assert mgr.draining()
+        assert tel.REGISTRY.get_gauge("server_draining") == 1
+        with pytest.raises(res.ServerDraining) as exc:
+            mgr.acquire("interactive", 0)
+        assert exc.value.retry_after_s > 0
+        with pytest.raises(res.ServerDraining):
+            mgr.claim_seat("batch")
+    finally:
+        mgr.end_drain()
+    assert not mgr.draining()
+    assert tel.REGISTRY.get_gauge("server_draining") == 0
+    # back to normal service
+    t = mgr.acquire("interactive", 0)
+    mgr.release(t)
+
+
+def test_drain_rejections_reconcile_counters(mgr):
+    mgr.begin_drain()
+    try:
+        def run():
+            with pytest.raises(res.ServerDraining):
+                mgr.acquire("background", 0)
+        d = _counter_delta(run, "sched_rejected_background",
+                           "sched_admitted_background")
+        assert d["sched_rejected_background"] == 1
+        assert d["sched_admitted_background"] == 0
+    finally:
+        mgr.end_drain()
+
+
+def test_inflight_query_survives_drain(mgr):
+    """Draining refuses NEW work; an already-admitted query keeps its slot
+    and releases normally."""
+    t = mgr.acquire("interactive", 0)
+    mgr.begin_drain()
+    try:
+        assert mgr.running_count() == 1
+        with pytest.raises(res.ServerDraining):
+            mgr.acquire("interactive", 0)
+        mgr.release(t)
+        assert mgr.running_count() == 0
+    finally:
+        mgr.end_drain()
+
+
+def test_drain_independent_of_enabled(monkeypatch):
+    """A draining process refuses new work even with the scheduler
+    subsystem off (the server's POST gate relies on this)."""
+    monkeypatch.setenv("DSQL_MAX_CONCURRENT_QUERIES", "0")
+    m = sched.WorkloadManager()
+    m.begin_drain()
+    try:
+        assert m.draining()
+        with pytest.raises(res.ServerDraining):
+            m.claim_seat("interactive")
+    finally:
+        m.end_drain()
