@@ -163,6 +163,12 @@ def _stage_main():
     # re-arms it to record hit-rate + warm latency as a SEPARATE metric.
     cache_mb = os.environ.get("DSQL_RESULT_CACHE_MB")
     os.environ["DSQL_RESULT_CACHE_MB"] = "0"
+    # tiered execution must not contaminate the measurement either: a
+    # first arrival served on the eager tier would record the eager path,
+    # not the compiled engine (DSQL_EAGER_FALLBACK=0 already disables the
+    # tier; this pins it for explicit-eager configs too).  The program
+    # STORE stays armed: store loads ARE the engine's cold path now.
+    os.environ.setdefault("DSQL_TIERED", "0")
     # the workload manager (runtime/scheduler.py, 4 slots by default) must
     # not throttle the 8-thread warmup pool: a compile that takes minutes
     # over the tunnel would blow the admission-queue timeout and lose the
@@ -185,6 +191,36 @@ def _stage_main():
         with open(progress_path, "a") as f:
             f.write(json.dumps(rec) + "\n")
             f.flush()
+
+    if os.environ.get("BENCH_WARM_RESTART") == "1":
+        # RESTART-WARM mode: this is a FRESH process pointed at the
+        # program store the measurement child populated — every query
+        # should load its stage executables with zero XLA compiles.  One
+        # run per query, journaled, plus the store-hit evidence the
+        # parent folds into program_store_hit_rate / warm_start_sec.
+        from dask_sql_tpu.physical import compiled as _cmp
+
+        t_w = time.perf_counter()
+        for qid in qids:
+            if left() < 10:
+                break
+            try:
+                t0r = time.perf_counter()
+                c.sql(QUERIES[qid], return_futures=False)
+                emit({"restart_q": qid,
+                      "sec": round(time.perf_counter() - t0r, 4),
+                      "platform": real_platform})
+            except Exception as e:
+                emit({"restart_fail": qid, "error": repr(e)[:200]})
+        snap = dict(_cmp.stats)
+        emit({"restart_done": True,
+              "warm_start_sec": round(time.perf_counter() - t_w, 2),
+              "program_store_hits": snap.get("program_store_hits", 0),
+              "program_store_errors": snap.get("program_store_errors", 0),
+              "compiles": snap.get("compiles", 0)})
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
 
     # warmup = compilation; compiles overlap across threads (tracing holds
     # the GIL but the backend compile releases it), which matters on the
@@ -236,6 +272,11 @@ def _stage_main():
             compiled_ok.add(q)
             last_warm_done[0] = time.perf_counter() - warm_t0
         emit({"warm_q": q, "sec": round(dt, 3)})
+        # first_arrival: latency of the very FIRST submission of this query
+        # in this bench run (the parent keeps the earliest record across
+        # children) — against a cold program store it is the compile wall,
+        # against a primed one it is the store-load + execute cost
+        emit({"first_arrival": q, "sec": round(dt, 3)})
 
     def learn_split_hint(q):
         """Persist the engine's "split this plan" hint for a query whose
@@ -578,6 +619,7 @@ def main():
         started, warm_fails, breakdowns, quiesced = set(), {}, {}, set()
         warm_hits = {}
         bursts = []
+        first_arrival, restart_times, restart_info = {}, {}, {}
         load_sec = warmup_sec = 0.0
         try:
             with open(state["progress"]) as f:
@@ -612,6 +654,15 @@ def main():
                             "tier": rec.get("tier")}
                     elif "warm_q" in rec:
                         warm_times[rec["warm_q"]] = rec["sec"]
+                    elif "first_arrival" in rec:
+                        # keep the EARLIEST record: retries in later
+                        # children are not "first" arrivals
+                        first_arrival.setdefault(rec["first_arrival"],
+                                                 rec["sec"])
+                    elif "restart_q" in rec:
+                        restart_times[rec["restart_q"]] = rec["sec"]
+                    elif rec.get("restart_done"):
+                        restart_info = rec
                     elif "warm_start" in rec:
                         started.add(rec["warm_start"])
                     elif "warm_fail" in rec:
@@ -703,6 +754,21 @@ def main():
                     "pandas_geomean_sec": round(geo_p, 4),
                     "warm_or_compile_sec_per_query":
                         {str(k): warm_times[k] for k in sorted(warm_times)},
+                    # tiered-execution / program-store evidence: latency of
+                    # each query's very first submission (cold store = the
+                    # compile wall; primed store = store-load + execute)...
+                    "first_arrival_sec": {str(k): first_arrival[k]
+                                          for k in sorted(first_arrival)},
+                    # ...and the restart-warm pass: a FRESH process against
+                    # the populated DSQL_PROGRAM_STORE (zero-compile proof)
+                    "restart_warm_sec": {str(k): restart_times[k]
+                                         for k in sorted(restart_times)},
+                    "warm_start_sec": restart_info.get("warm_start_sec"),
+                    "program_store_hit_rate": (
+                        round(restart_info["program_store_hits"]
+                              / max(restart_info["program_store_hits"]
+                                    + restart_info["compiles"], 1), 3)
+                        if restart_info else None),
                     # result-cache evidence from the warm-repeat pass: the
                     # 2nd run of each query with the cache armed (cold
                     # numbers above always run cache-off)
@@ -874,6 +940,12 @@ def main():
     env_base.setdefault("DSQL_XLA_CACHE", os.path.join(cache_root, "xla"))
     env_base.setdefault("DSQL_CAPS_FILE",
                         os.path.join(cache_root, "caps.json"))
+    # persistent program store (runtime/program_store.py): the measurement
+    # child populates it, the restart-warm child below proves a fresh
+    # process serves every query with zero XLA compiles, and a bench run
+    # primed by an earlier run on this host starts warm outright
+    env_base.setdefault("DSQL_PROGRAM_STORE",
+                        os.path.join(cache_root, "programs"))
 
     def journal_state():
         """(measured set, warm-failure counts) from the progress file."""
@@ -974,6 +1046,31 @@ def main():
             proc.kill()
             proc.communicate()  # reap
             state["stage_meta"].append({"attempt": "cpu_salvage",
+                                        "error": "timeout"})
+        finally:
+            state["child"] = None
+
+    # RESTART-WARM pass: a FRESH process against the populated program
+    # store re-runs the measured queries — the cross-process warm-start
+    # evidence (program_store_hit_rate, warm_start_sec, per-query
+    # restart_warm_sec) without touching the cold numbers above
+    restart_left = deadline - EMIT_MARGIN - time.monotonic()
+    got_now = sorted(journal_state()[0])
+    if got_now and restart_left > 60:
+        env = dict(env_base, BENCH_WARM_RESTART="1",
+                   BENCH_STAGE_QUERIES=",".join(map(str, got_now)),
+                   BENCH_CHILD_DEADLINE=str(time.time() + restart_left - 10))
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        state["child"] = proc
+        try:
+            proc.communicate(timeout=restart_left)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()  # reap
+            state["stage_meta"].append({"attempt": "restart_warm",
                                         "error": "timeout"})
         finally:
             state["child"] = None

@@ -386,8 +386,16 @@ class Context:
         # the eager per-op executor for plan shapes outside its subset
         from .physical.compiled import try_execute_compiled
         result = try_execute_compiled(plan, self)
+        # execution-tier annotation (tiered execution, physical/compiled):
+        # "compiled", "eager", or the gate's own "eager-compiling" — the
+        # gate's verdict wins, so only fill in when it said nothing
+        span = _tel.current_span()
         if result is None:
+            if span is not None:
+                span.attrs.setdefault("tier", "eager")
             result = RelExecutor(self).execute(plan)
+        elif span is not None:
+            span.attrs.setdefault("tier", "compiled")
         # populate only on the success path: a crashed / deadline-exceeded
         # execution raised before this line and never reaches the cache
         if ckey is not None and result is not None and cache.put(ckey, result):

@@ -46,6 +46,7 @@ import threading as _threading
 import logging
 import math
 import os
+import re
 import time
 import weakref
 from collections import OrderedDict
@@ -64,7 +65,8 @@ from ..plan.nodes import (
     LogicalTableScan, LogicalUnion, LogicalValues, LogicalWindow, RelNode,
     RexCall, RexInputRef, RexLiteral, RexNode,
 )
-from ..runtime import (faults as _faults, quarantine as _quar,
+from ..runtime import (faults as _faults, kvstore as _kv,
+                       program_store as _pstore, quarantine as _quar,
                        resilience as _res, result_cache as _rcache,
                        telemetry as _tel)
 from ..table import dict_sort_order, Column, Scalar, Table
@@ -1882,15 +1884,17 @@ class _Tracer:
 # ---------------------------------------------------------------------------
 
 class _Compiled:
-    __slots__ = ("fn", "spec", "meta", "caps", "key", "origin")
+    __slots__ = ("fn", "spec", "meta", "caps", "key", "origin", "aot")
 
-    def __init__(self, fn, spec, meta, caps, key, origin=None):
+    def __init__(self, fn, spec, meta, caps, key, origin=None, aot=False):
         self.fn = fn
         self.spec = spec
         self.meta = meta        # filled during first trace
         self.caps = caps
         self.key = key
         self.origin = origin    # root-query fingerprint that compiled it
+        self.aot = aot          # fn is an AOT jax.stages.Compiled (the
+                                # serializable form the program store needs)
 
 
 _cache: "OrderedDict[tuple, object]" = OrderedDict()
@@ -1914,19 +1918,15 @@ _caps_seed: Optional[Dict[str, Dict[str, int]]] = None
 
 
 def _caps_disk_key(base_key) -> str:
-    return hashlib.blake2b(repr(base_key).encode(),
-                           digest_size=16).hexdigest()
+    return _kv.digest_key(base_key)
 
 
 def _caps_disk_read(path: str) -> Dict[str, Dict[str, int]]:
-    import json
-    try:
-        with open(path) as f:
-            loaded = json.load(f)
-        return {k: {t: int(c) for t, c in v.items()}
-                for k, v in loaded.items() if isinstance(v, dict)}
-    except (OSError, ValueError):
-        return {}
+    """Tolerant caps-file read on the shared kvstore plumbing
+    (runtime/kvstore.py — the same atomic-write/corrupt-tolerant
+    discipline the quarantine store and the program store index use)."""
+    return {k: {t: int(c) for t, c in v.items()}
+            for k, v in _kv.read_json_dict(path).items()}
 
 
 def _learned_caps_get(base_key) -> Dict[str, int]:
@@ -1962,28 +1962,154 @@ def _learned_caps_put(base_key, caps: Dict[str, int]) -> None:
     path = os.environ.get("DSQL_CAPS_FILE")
     if not path:
         return
-    import json
-    import threading
     global _caps_disk
     # read-merge-replace: concurrent writers (threaded warmup) can lose a
-    # race, which only costs one re-learn — never corrupts (atomic replace;
-    # tmp name is per-thread so two warmup threads can't interleave bytes)
+    # race, which only costs one re-learn — never corrupts (kvstore's
+    # atomic replace; tmp name is per-thread so two warmup threads can't
+    # interleave bytes)
     disk = _caps_disk_read(path)
     disk[_caps_disk_key(base_key)] = {k: int(v) for k, v in caps.items()}
-    tmp = f"{path}.tmp{os.getpid()}.{threading.get_ident()}"
-    try:
-        with open(tmp, "w") as f:
-            json.dump(disk, f)
-        os.replace(tmp, path)
+    if _kv.atomic_write_json(path, disk):
         _caps_disk = disk
-    except OSError:
-        logger.debug("caps file %s not writable", path)
 
 
 def _bounded_put(d: OrderedDict, key, value):
     while len(d) >= _LEARNED_LIMIT:
         d.popitem(last=False)
     d[key] = value
+
+
+# ---------------------------------------------------------------------------
+# persistent program store glue (runtime/program_store.py): a successfully
+# compiled program's XLA executable is serialized to DSQL_PROGRAM_STORE so a
+# fresh process (server restart, new bench child) loads it with ZERO
+# recompilation; a compile-cache miss consults the store before paying XLA.
+# ---------------------------------------------------------------------------
+
+# stage-boundary temp names embed per-process table uids (_stage_table_name)
+# but the compiled program is uid-independent — it depends only on plan
+# shape and input layout.  For the cross-process store key, boundary names
+# are rewritten to position-stable placeholders so two processes running
+# the same query over the same-layout data address the same entry.
+_BOUNDARY_NAME_RE = re.compile(r"__split__\.t[0-9a-f]{16}")
+
+
+def _canonical_program_key(base_key):
+    plan_fp, inputs_fp, on_tpu = base_key
+    mapping: Dict[str, str] = {}
+
+    def sub(m):
+        return mapping.setdefault(m.group(0), f"__split__.#{len(mapping)}")
+
+    return (_BOUNDARY_NAME_RE.sub(sub, plan_fp), inputs_fp, on_tpu)
+
+
+def _pstore_digest(base_key) -> str:
+    return _pstore.get_store().digest(_canonical_program_key(base_key))
+
+
+def _pstore_put(entry: _Compiled, base_key, n_args: int, n_outs: int
+                ) -> None:
+    """Serialize + persist a freshly compiled program (best-effort; only
+    AOT-compiled entries carry a serializable executable)."""
+    store = _pstore.get_store()
+    if not store.enabled() or not entry.aot:
+        return
+    try:
+        from jax.experimental import serialize_executable as _se
+        payload, _, _ = _se.serialize(entry.fn)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as e:
+        _tel.inc("program_store_errors")
+        logger.debug("program serialize failed (%s); not persisted", e)
+        return
+    store.store(_pstore_digest(base_key), {
+        "v": 1,
+        "caps": {k: int(v) for k, v in entry.caps.items()},
+        "spec": entry.spec,
+        "meta": entry.meta,
+        "payload": payload,
+        "n_args": int(n_args),
+        "n_outs": int(n_outs),
+    })
+
+
+def _pstore_attempt(base_key, flat):
+    """Load + execute this program from the persistent store.
+
+    Returns (entry, outs, caps) on a hit — the executable deserialized
+    with zero XLA compilation, its first execution already done — or None
+    (miss, corrupt entry, fingerprint mismatch, arity drift), in which
+    case the caller compiles normally.  The fn signature's pytree
+    structure is flat tuples by construction (_build), so the arg/out
+    treedefs are reconstructed from counts instead of being pickled.
+    """
+    store = _pstore.get_store()
+    if not store.enabled():
+        return None
+    raw = store.load(_pstore_digest(base_key))
+    if raw is None:
+        return None
+    try:
+        import jax.tree_util as _jtu
+        from jax.experimental import serialize_executable as _se
+        if int(raw.get("v", 0)) != 1 or int(raw["n_args"]) != len(flat):
+            raise ValueError("entry layout mismatch")
+        in_tree = _jtu.tree_structure((tuple(range(len(flat))), {}))
+        out_tree = _jtu.tree_structure(tuple(range(int(raw["n_outs"]))))
+        fn = _se.deserialize_and_load(raw["payload"], in_tree, out_tree)
+        caps = {str(k): int(v) for k, v in (raw.get("caps") or {}).items()}
+        entry = _Compiled(fn, raw["spec"], raw["meta"], caps,
+                          (base_key, tuple(sorted(caps.items()))), aot=True)
+        outs = entry.fn(*flat)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as e:
+        # a stored executable that won't deserialize or execute here is as
+        # good as corrupt: count it, fall back to a normal compile
+        _tel.inc("program_store_errors")
+        logger.warning("program store load failed (%s: %s); recompiling",
+                       type(e).__name__, str(e)[:120])
+        return None
+    _tel.inc("program_store_hits")
+    _tel.annotate(program_store="hit")
+    return entry, outs, caps
+
+
+# ---------------------------------------------------------------------------
+# compile-worker backoff: BENCH_r05's 10 compile_errors coincided with
+# 4-way concurrent XLA builds OOM-killing the shared remote compile helper.
+# Consecutive compile failures halve the effective worker width (floor 1,
+# DSQL_COMPILE_BACKOFF_AFTER failures per halving, counter
+# ``compile_backoffs``) so warmup degrades to narrower concurrency instead
+# of erroring; any successful compile restores the full width.
+# ---------------------------------------------------------------------------
+
+_compile_fail_streak = 0
+
+
+def _backoff_after() -> int:
+    try:
+        return max(1, int(os.environ.get("DSQL_COMPILE_BACKOFF_AFTER", "2")))
+    except ValueError:
+        return 2
+
+
+def _note_compile_result(ok: bool) -> None:
+    global _compile_fail_streak
+    after = _backoff_after()
+    with _state_lock:
+        if ok:
+            _compile_fail_streak = 0
+            return
+        _compile_fail_streak += 1
+        crossed = _compile_fail_streak % after == 0
+    if crossed:
+        _tel.inc("compile_backoffs")
+        logger.warning(
+            "%d consecutive compile failures; halving effective compile "
+            "workers (now %d)", _compile_fail_streak, _compile_workers())
 
 
 def _flatten_tables(scans) -> List[jax.Array]:
@@ -2398,12 +2524,21 @@ def _unregister_stage_table(context, name: str) -> None:
             sch.tables.pop(name, None)
 
 
-def _compile_workers(n_stages: int) -> int:
+def _compile_workers(n_stages: Optional[int] = None) -> int:
+    """Effective compile-pool width: the DSQL_COMPILE_WORKERS budget,
+    halved once per DSQL_COMPILE_BACKOFF_AFTER consecutive compile
+    failures (see _note_compile_result), capped by the stage count."""
     try:
         w = int(os.environ.get("DSQL_COMPILE_WORKERS", "4"))
     except ValueError:
         w = 4
-    return max(1, min(w, n_stages))
+    with _state_lock:
+        halvings = _compile_fail_streak // _backoff_after()
+    if halvings:
+        w = max(1, w >> min(halvings, 8))
+    if n_stages is not None:
+        w = min(w, n_stages)
+    return max(1, w)
 
 
 def _execute_stage_graph(graph: StageGraph, context, query_fp: str,
@@ -2607,6 +2742,151 @@ def _execute_stage_graph_inner(graph: StageGraph, context, query_fp: str,
             _unregister_stage_table(context, name)
 
 
+# ---------------------------------------------------------------------------
+# tiered execution: first arrival must not pay the compile wall.  When a
+# plan's stage programs are not yet available (in memory OR in the
+# persistent program store), the query is answered IMMEDIATELY on the
+# eager/interpreted tier (the RelExecutor machinery EXPLAIN ANALYZE uses)
+# while the stage programs compile in background daemon threads bounded by
+# the same DSQL_COMPILE_WORKERS width (and its failure backoff); the next
+# arrival of the same plan shape runs compiled.  Flare's tiered
+# native-compilation story (PAPERS.md).  The tier decision honors:
+#   - the degradation ladder: DSQL_EAGER_FALLBACK=0 forbids the eager tier
+#     entirely (there is no tier to serve from), so compiles stay
+#     synchronous exactly as before;
+#   - quarantine / exile / runtime verdicts: a plan with a standing
+#     verdict is "decided" — it runs the normal path (which serves eager
+#     with the proper counters) and never spawns background work;
+#   - the workload manager: background compiles bypass admission entirely,
+#     so they hold no scheduler slot and no memory-broker reservation.
+# Disable with DSQL_TIERED=0 (tests pin this off; production default on).
+# ---------------------------------------------------------------------------
+
+_tier_lock = _threading.Lock()
+_tier_done: "OrderedDict[tuple, bool]" = OrderedDict()  # attempted keys
+_tier_inflight: set = set()
+_tier_local = _threading.local()          # .bg guards recursion
+_bg_sem: Optional[object] = None          # bounds concurrent bg compiles
+
+
+def _tiering_enabled() -> bool:
+    if os.environ.get("DSQL_TIERED", "1") == "0":
+        return False
+    # the eager tier IS the eager fallback; with it forbidden there is
+    # nothing to serve the first arrival from
+    if os.environ.get("DSQL_EAGER_FALLBACK", "1") == "0":
+        return False
+    return True
+
+
+def _program_decided(base_key, scans) -> bool:
+    """True when the normal path needs NO fresh XLA compile for this one
+    program: an in-memory entry (or _UNSUPPORTED verdict), a runtime-eager
+    exile, a standing quarantine verdict, or a persistent-store entry."""
+    caps = _learned_caps_get(base_key)
+    caps.pop("__split__", None)
+    key = (base_key, tuple(sorted(caps.items())))
+    runtime_key = (base_key, tuple(t.uid for _, t, _ in scans))
+    with _state_lock:
+        if key in _cache or runtime_key in _runtime_eager:
+            return True
+    qstore = _quar.get_store()
+    if qstore.enabled() and _quar.program_key(base_key) in qstore.entries():
+        # skip/half-open-probe semantics belong to the normal path
+        return True
+    return _pstore.get_store().contains(_pstore_digest(base_key))
+
+
+def _probe_single(plan: RelNode, context, on_tpu: bool) -> bool:
+    """Readiness of ONE program, keyed exactly as _execute_single will key
+    it — including the off-TPU terminal-ORDER-BY peel (the host-sort
+    program is compiled for ``plan.input``, not ``plan``)."""
+    if not on_tpu and isinstance(plan, LogicalSort):
+        plan = plan.input
+    scans: list = []
+    try:
+        fp = _fp_plan(plan, context, scans)
+    except Unsupported:
+        return True  # needs no compile; the normal path serves it eager
+    return _program_decided((fp, _fp_inputs(scans), on_tpu), scans)
+
+
+def _programs_ready(plan: RelNode, context, base_key, budget: int) -> bool:
+    """Would the normal compiled path answer without paying a fresh XLA
+    compile?  Whole-plan programs are probed exactly; stage graphs are
+    probed at their LEAF stages (deeper stages scan boundary temps that do
+    not exist before execution) — with a warm store every stage hits, so
+    all-leaves-warm is the right readiness signal."""
+    on_tpu = base_key[2]
+    heavy = _heavy_count(plan)
+    if heavy <= budget:
+        return _probe_single(plan, context, on_tpu)
+    graph = _partition_plan(plan, budget, context)
+    if len(graph.stages) <= 1:
+        return _probe_single(plan, context, on_tpu)
+    for st in graph.stages:
+        if st.deps:
+            continue
+        if not _probe_single(st.plan, context, on_tpu):
+            return False
+    return True
+
+
+def _background_compile(plan: RelNode, context, base_key) -> None:
+    """Compile (and once-execute) this plan's stage programs off the query
+    path.  Runs in a daemon thread with fresh thread-locals: no deadline,
+    no trace, no scheduler slot, no memory-broker reservation — exactly
+    the full normal pipeline minus supervision, so learned caps, the
+    program cache, quarantine interplay, and the persistent store all
+    populate the same way a foreground compile would."""
+    _tier_local.bg = True
+    try:
+        with _bg_sem:
+            try:
+                try_execute_compiled(plan, context)
+                _tel.inc("background_compiles_done")
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:
+                _tel.inc("background_compile_errors")
+                logger.warning("background compile failed (%s: %s)",
+                               type(e).__name__, str(e)[:200])
+    finally:
+        _tier_local.bg = False
+        with _tier_lock:
+            _tier_inflight.discard(base_key)
+            _bounded_put(_tier_done, base_key, True)
+
+
+def _tier_serve_eager(plan: RelNode, context, base_key, budget: int,
+                      split_limit: Optional[int]) -> bool:
+    """The tier decision: True => answer THIS arrival on the eager tier
+    (the caller returns None) while the programs build in the background."""
+    if split_limit is not None or not _tiering_enabled() \
+            or getattr(_tier_local, "bg", False):
+        return False
+    global _bg_sem
+    with _tier_lock:
+        if base_key in _tier_done:
+            return False  # background attempt finished; run the verdict
+        if base_key in _tier_inflight:
+            return True   # still compiling behind the scenes
+    if _programs_ready(plan, context, base_key, budget):
+        return False
+    with _tier_lock:
+        if base_key in _tier_done or base_key in _tier_inflight:
+            return True
+        _tier_inflight.add(base_key)
+        if _bg_sem is None:
+            _bg_sem = _threading.Semaphore(_compile_workers())
+    # daemon threads (not a pool): process exit must never block on a
+    # wedged XLA build, and the semaphore bounds real concurrency
+    _threading.Thread(target=_background_compile,
+                      args=(plan, context, base_key),
+                      name="dsql-bg-compile", daemon=True).start()
+    return True
+
+
 def try_execute_compiled(plan: RelNode, context,
                          _split_limit: Optional[int] = None
                          ) -> Optional[Table]:
@@ -2644,6 +2924,13 @@ def try_execute_compiled(plan: RelNode, context,
         if hint is not None:
             budget_override = int(hint)
     budget = stage_budget(budget_override)
+    # tiered execution: a cold plan answers on the eager tier NOW while
+    # its stage programs compile in the background; warm (or decided)
+    # plans fall through to the normal compiled path
+    if _tier_serve_eager(plan, context, base_key, budget, _split_limit):
+        _tel.inc("served_eager_while_compiling")
+        _tel.annotate(tier="eager-compiling")
+        return None
     if heavy > budget:
         graph = _partition_plan(plan, budget, context)
         if len(graph.stages) > 1:
@@ -2708,6 +2995,7 @@ def _execute_single(plan: RelNode, context, query_fp: str,
     # "__split__" is the learned budget hint, not an aggregate-site cap: it
     # must not leak into the program cache key or _build's cap lookups
     caps.pop("__split__", None)
+    store_tried = False  # one persistent-store attempt per call, tops
     for _ in range(8):  # capacity-escalation bound
         _res.check("execute")
         key = (base_key, tuple(sorted(caps.items())))
@@ -2742,6 +3030,32 @@ def _execute_single(plan: RelNode, context, query_fp: str,
             _tel.inc("unsupported")
             return None
         flat = _flatten_tables(scans)
+        outs = None
+        if entry is None and not store_tried and _pstore.get_store().enabled():
+            # persistent program store: a prior process compiled this exact
+            # program (canonical plan + input layout + device + jax
+            # version) — deserialize its XLA executable and run with ZERO
+            # recompilation.  The stored caps supersede the local guess
+            # (they were learned by actually running this program).
+            store_tried = True
+            with _tel.span("program_store_load"):
+                got = _pstore_attempt(base_key, flat)
+            if got is not None:
+                loaded, outs, caps = got
+                if my_event is not None:
+                    # release the in-flight claim taken under the caps we
+                    # guessed before the load told us the real ones
+                    with _state_lock:
+                        _inflight.pop(key, None)
+                    my_event.set()
+                    my_event = None
+                key = (base_key, tuple(sorted(caps.items())))
+                loaded.key = key
+                with _state_lock:
+                    while len(_cache) >= _CACHE_LIMIT:
+                        _cache.popitem(last=False)
+                    _cache[key] = loaded
+                entry = loaded
         if entry is None:
             degrade = None
             qstore = _quar.get_store()
@@ -2781,7 +3095,14 @@ def _execute_single(plan: RelNode, context, query_fp: str,
                                 _faults.maybe_fail("compile")
                                 entry = _build(plan, context, scans, caps,
                                                key, origin=query_fp)
-                                # first call traces+compiles
+                                if _pstore.get_store().enabled():
+                                    # AOT lower+compile: same trace, same
+                                    # XLA build, but the executable object
+                                    # exists to serialize into the store
+                                    lowered = entry.fn.lower(*flat)
+                                    entry.fn = lowered.compile()
+                                    entry.aot = True
+                                # first call traces+compiles (AOT: runs)
                                 outs = entry.fn(*flat)
                             break
                         except Unsupported as e:
@@ -2807,6 +3128,7 @@ def _execute_single(plan: RelNode, context, query_fp: str,
                                                 _res.QueryCancelled)):
                                 raise err if err is e else err from e
                             _tel.inc("compile_errors")
+                            _note_compile_result(False)
                             attempt += 1
                             # retry annotation on the compile span itself:
                             # a report showing compile=120s attempts=3
@@ -2829,6 +3151,7 @@ def _execute_single(plan: RelNode, context, query_fp: str,
                             break
                 if degrade is None:
                     _tel.inc("compiles")
+                    _note_compile_result(True)
                     if in_stage:
                         _tel.inc("stage_compiles")
                     if qstore.enabled():
@@ -2841,6 +3164,10 @@ def _execute_single(plan: RelNode, context, query_fp: str,
                         while len(_cache) >= _CACHE_LIMIT:
                             _cache.popitem(last=False)
                         _cache[key] = entry
+                    # persist the executable so a FRESH process never
+                    # re-pays this compile (best-effort; outside the
+                    # watchdog — serialization cannot wedge XLA)
+                    _pstore_put(entry, base_key, len(flat), len(outs))
             finally:
                 if my_event is not None:
                     with _state_lock:
@@ -2849,7 +3176,7 @@ def _execute_single(plan: RelNode, context, query_fp: str,
             if degrade is not None:
                 return _degrade_compile(plan, context, base_key, key,
                                         degrade[0], degrade[1], split_limit)
-        else:
+        elif outs is None:  # in-memory hit (a store load already ran once)
             _tel.inc("hits")
             _tel.annotate(cache_hit=True)
             if in_stage:

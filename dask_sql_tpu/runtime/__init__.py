@@ -19,6 +19,15 @@
                   expiry and half-open probes) plus the compile watchdog
                   that catches builds wedged inside XLA where cooperative
                   deadline checks cannot run.
+``kvstore``     — the shared cross-process JSON store plumbing (content
+                  digests, atomic tmp+rename writes, corrupt-file
+                  tolerance, mtime-cached reads) the caps file, the
+                  quarantine store, and the program store index all use.
+``program_store`` — persistent cross-process program store: serialized
+                  compiled stage executables keyed by canonical program
+                  identity + device/jax fingerprint, with a byte-budget
+                  LRU, so a fresh process serves previously-seen queries
+                  with zero XLA recompilation.
 """
-from . import (faults, quarantine, resilience, result_cache,  # noqa: F401
-               scheduler, telemetry)
+from . import (faults, kvstore, program_store, quarantine,  # noqa: F401
+               resilience, result_cache, scheduler, telemetry)

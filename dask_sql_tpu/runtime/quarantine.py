@@ -47,7 +47,6 @@ contract, runtime/telemetry.py).
 from __future__ import annotations
 
 import hashlib
-import json
 import logging
 import os
 import threading
@@ -55,6 +54,7 @@ import time
 from contextlib import contextmanager
 from typing import Dict, Optional
 
+from . import kvstore as _kv
 from . import telemetry as _tel
 
 logger = logging.getLogger(__name__)
@@ -103,15 +103,15 @@ def program_key(base_key) -> str:
 
 class QuarantineStore:
     """JSON-file store of crash/hang verdicts with expiry + half-open
-    probes.  Reads are mtime-cached; writes are read-merge-replace with an
-    atomic rename (the ``_learned_caps_put`` discipline), so concurrent
-    writers can lose a race — costing one re-mark — but never corrupt."""
+    probes.  Disk plumbing rides runtime/kvstore.py (shared with the
+    learned-caps file and the program store's index): reads are
+    mtime-cached and corrupt-tolerant; writes are read-merge-replace with
+    an atomic rename, so concurrent writers can lose a race — costing one
+    re-mark — but never corrupt."""
 
     def __init__(self, path: Optional[str] = None):
         self._path_override = path
-        self._lock = threading.Lock()
-        self._cached: Dict[str, dict] = {}
-        self._cached_mtime: Optional[int] = None
+        self._file = _kv.MtimeCachedJsonFile(self.path)
 
     # -- config (env-read per call so tests/operators flip without restart)
     def path(self) -> Optional[str]:
@@ -127,51 +127,14 @@ class QuarantineStore:
         return max(_env_float("DSQL_QUARANTINE_PROBE_S", DEFAULT_PROBE_S),
                    0.001)
 
-    # -- disk ---------------------------------------------------------------
+    # -- disk (runtime/kvstore.py: mtime-cached tolerant reads, atomic
+    # tmp+rename writes — a broken quarantine file must degrade to 'no
+    # quarantine', never fail a query) ------------------------------------
     def _read(self) -> Dict[str, dict]:
-        """Load the store, tolerant of a missing/corrupt/truncated file —
-        a broken quarantine file must degrade to 'no quarantine', never
-        fail a query."""
-        path = self.path()
-        if not path:
-            return {}
-        try:
-            mtime = os.stat(path).st_mtime_ns
-        except OSError:
-            with self._lock:
-                self._cached, self._cached_mtime = {}, None
-            return {}
-        with self._lock:
-            if self._cached_mtime == mtime:
-                return dict(self._cached)
-        try:
-            with open(path) as f:
-                loaded = json.load(f)
-            data = {k: dict(v) for k, v in loaded.items()
-                    if isinstance(v, dict)}
-        except (OSError, ValueError):
-            data = {}
-        with self._lock:
-            self._cached, self._cached_mtime = data, mtime
-        return dict(data)
+        return self._file.read()
 
     def _write(self, data: Dict[str, dict]) -> None:
-        path = self.path()
-        if not path:
-            return
-        tmp = f"{path}.tmp{os.getpid()}.{threading.get_ident()}"
-        try:
-            with open(tmp, "w") as f:
-                json.dump(data, f)
-            os.replace(tmp, path)
-            with self._lock:
-                self._cached = dict(data)
-                try:
-                    self._cached_mtime = os.stat(path).st_mtime_ns
-                except OSError:
-                    self._cached_mtime = None
-        except OSError:
-            logger.debug("quarantine file %s not writable", path)
+        self._file.write(data)
 
     # -- verdicts -----------------------------------------------------------
     def check(self, key: str) -> Optional[str]:

@@ -79,6 +79,16 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     "stage_execs", "stage_replays", "stage_replay_saved_stages",
     "quarantine_skips", "quarantine_probes", "quarantine_marks",
     "watchdog_trips",
+    # tiered execution (physical/compiled.py): queries answered on the
+    # eager tier while their stage programs compiled in the background,
+    # background compiles that landed / errored, and compile-worker
+    # halvings under consecutive-compile-failure pressure
+    "served_eager_while_compiling", "background_compiles_done",
+    "background_compile_errors", "compile_backoffs",
+    # persistent cross-process program store (runtime/program_store.py)
+    "program_store_hits", "program_store_misses", "program_store_stores",
+    "program_store_rejects", "program_store_evictions",
+    "program_store_errors",
     # workload manager (runtime/scheduler.py): per-class admission
     # outcomes; for any submission mix, admitted + rejected + timeout
     # always sums to the queries that entered admission
@@ -468,7 +478,7 @@ class QueryReport:
     under concurrency).  ``root``: the span tree."""
 
     __slots__ = ("query", "wall_ms", "phases", "counters", "root",
-                 "rows_out", "bytes_out", "started_unix", "cache")
+                 "rows_out", "bytes_out", "started_unix", "cache", "tier")
 
     def __init__(self, trace: QueryTrace):
         root = trace.root
@@ -501,6 +511,10 @@ class QueryReport:
         tier: Optional[str] = None
         stored = False
         subplan_hits = 0
+        # execution tier (tiered execution, physical/compiled.py):
+        # "compiled" / "eager" / "eager-compiling" (served on the eager
+        # tier while the stage programs build in the background)
+        exec_tier: Optional[str] = None
         for s in root.walk():
             rc = s.attrs.get("result_cache")
             if rc == "hit":
@@ -510,6 +524,10 @@ class QueryReport:
                 stored = True
             if s.attrs.get("subplan_cache") == "hit":
                 subplan_hits += 1
+            t = s.attrs.get("tier")
+            if t is not None and exec_tier is None:
+                exec_tier = str(t)
+        self.tier = exec_tier
         self.cache = {"hit": hit, "tier": tier, "stored": stored,
                       "subplan_hits": subplan_hits,
                       "bytes": int(REGISTRY.get_gauge("result_cache_bytes")),
@@ -524,6 +542,7 @@ class QueryReport:
                 "phases": {k: round(v, 3) for k, v in self.phases.items()},
                 "counters": dict(self.counters),
                 "cache": dict(self.cache),
+                "tier": self.tier,
                 "rows_out": self.rows_out, "bytes_out": self.bytes_out,
                 "spans": self.root.to_dict()}
 

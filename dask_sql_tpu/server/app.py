@@ -138,6 +138,13 @@ def _stats(state: str, info: Optional["_QueryInfo"] = None) -> dict:
             out["cacheTier"] = info.cache_tier
         if info.subplan_cache_hits:
             out["subplanCacheHits"] = info.subplan_cache_hits
+        # execution tier (tiered execution, physical/compiled.py):
+        # "compiled" / "eager" / "eager-compiling", plus the persistent
+        # program-store loads this query was served warm from
+        if info.tier:
+            out["tier"] = info.tier
+        if info.program_store_hits:
+            out["programStoreHits"] = info.program_store_hits
         if info.phases:
             # per-query phase breakdown from the query's own QueryReport
             # (race-free: the report is thread-local to the worker that
@@ -151,7 +158,7 @@ class _QueryInfo:
     __slots__ = ("submitted", "started", "finished", "cpu_sec", "rows",
                  "bytes", "peak_memory", "compiles", "cache_hits", "phases",
                  "cache_hit", "cache_tier", "subplan_cache_hits",
-                 "queued_ms")
+                 "queued_ms", "tier", "program_store_hits")
 
     def __init__(self):
         self.submitted = time.monotonic()
@@ -168,6 +175,8 @@ class _QueryInfo:
         self.cache_tier = None
         self.subplan_cache_hits = 0
         self.queued_ms = None
+        self.tier = None
+        self.program_store_hits = 0
 
 
 def _run_tracked(context, sql: str, info: _QueryInfo,
@@ -210,6 +219,9 @@ def _run_tracked(context, sql: str, info: _QueryInfo,
             info.cache_hit = bool(cache.get("hit"))
             info.cache_tier = cache.get("tier")
             info.subplan_cache_hits = int(cache.get("subplan_hits", 0))
+            info.tier = getattr(report, "tier", None)
+            info.program_store_hits = int(
+                (report.counters or {}).get("program_store_hits", 0))
     if table is not None and getattr(table, "num_columns", 0):
         info.rows = table.num_rows
         info.bytes = sum(int(getattr(c.data, "nbytes", 0))

@@ -24,6 +24,10 @@ import os
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# this gate asserts SYNCHRONOUS compile behavior; tiered execution
+# (eager-first + background compile, on by default) is gated by
+# scripts/warmstart_smoke.py instead
+os.environ.setdefault("DSQL_TIERED", "0")
 os.environ["DSQL_RESULT_CACHE_MB"] = "128"
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))

@@ -43,6 +43,10 @@ import threading
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# this gate asserts SYNCHRONOUS compile behavior; tiered execution
+# (eager-first + background compile, on by default) is gated by
+# scripts/warmstart_smoke.py instead
+os.environ.setdefault("DSQL_TIERED", "0")
 os.environ.setdefault("DSQL_MAX_CONCURRENT_QUERIES", "2")
 os.environ.setdefault("DSQL_QUEUE_DEPTH", "64")
 os.environ.setdefault("DSQL_QUEUE_TIMEOUT_MS", "120000")

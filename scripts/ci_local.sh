@@ -57,6 +57,13 @@ echo "=== [2f] chaos soak (failure-domain recovery) ==="
 # queries, admission counters reconcile, engine healthy afterwards
 python scripts/chaos_soak.py --budget-s 45
 
+echo "=== [2g] warm-start smoke (tiered execution + program store) ==="
+# a fresh process pointed at a populated DSQL_PROGRAM_STORE must answer
+# previously-seen queries with ZERO XLA compiles; with an empty store and
+# a slowed compile, the first arrival must answer on the eager tier
+# without blocking, then run compiled on the next arrival
+python scripts/warmstart_smoke.py
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \

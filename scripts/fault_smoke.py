@@ -18,6 +18,10 @@ import os
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# this gate asserts SYNCHRONOUS compile behavior; tiered execution
+# (eager-first + background compile, on by default) is gated by
+# scripts/warmstart_smoke.py instead
+os.environ.setdefault("DSQL_TIERED", "0")
 os.environ.setdefault("DSQL_FAULT_INJECT", "compile:1")
 os.environ.setdefault("DSQL_RETRY_BASE_MS", "1")
 

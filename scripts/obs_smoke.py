@@ -27,6 +27,10 @@ import tempfile
 import urllib.request
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# this gate asserts SYNCHRONOUS compile behavior; tiered execution
+# (eager-first + background compile, on by default) is gated by
+# scripts/warmstart_smoke.py instead
+os.environ.setdefault("DSQL_TIERED", "0")
 TRACE_DIR = tempfile.mkdtemp(prefix="dsql_obs_")
 os.environ["DSQL_CHROME_TRACE_DIR"] = TRACE_DIR
 os.environ["DSQL_SLOW_QUERY_MS"] = "0"   # every query trips the slow log

@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""Warm-start smoke gate: the program store + tiered execution must work.
+
+Run by scripts/ci_local.sh (mirroring cache_smoke.py / sched_smoke.py):
+
+    python scripts/warmstart_smoke.py
+
+Asserts, across REAL process boundaries:
+
+  1. a populate process (tiering off, store armed) compiles its queries
+     and persists every stage program (``program_store_stores`` > 0);
+  2. a FRESH process pointed at the populated ``DSQL_PROGRAM_STORE``
+     answers the same queries with ZERO XLA compiles
+     (``compiles == 0``, ``program_store_hits`` > 0) and byte-identical
+     results — the restart-warm guarantee;
+  3. tiered execution: with an EMPTY store and a slowed compile, the very
+     first arrival of an uncompiled query returns the oracle-correct
+     answer on the eager tier (``served_eager_while_compiling`` >= 1)
+     without blocking on stage compilation, and stays under an
+     eager-tier latency bound; the background compile then lands and the
+     next arrival runs compiled.
+
+Exit 0 on success — if cross-process warm starts silently rot (digests
+drift, fingerprints stop matching, the tier gate stops firing), this gate
+fails loudly.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("DSQL_RESULT_CACHE_MB", "0")
+os.environ.setdefault("DSQL_MAX_CONCURRENT_QUERIES", "0")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+N = 120_000
+
+QUERIES = [
+    # single-program aggregate
+    "SELECT k, SUM(v) AS s, COUNT(*) AS n FROM t GROUP BY k ORDER BY k",
+    # join + group-by: with DSQL_STAGE_HEAVY=1 this runs as a stage GRAPH,
+    # so the warm process must hit the store once per stage program
+    "SELECT d.name, SUM(t.v) AS s FROM t JOIN d ON t.k = d.k "
+    "GROUP BY d.name ORDER BY d.name",
+]
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _write_data(data_dir: str) -> None:
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.RandomState(7)
+    pd.DataFrame({
+        "k": rng.randint(0, 32, N),
+        "v": rng.rand(N),
+    }).to_feather(os.path.join(data_dir, "t.feather"))
+    pd.DataFrame({
+        "k": np.arange(32),
+        "name": [f"grp{i % 8}" for i in range(32)],
+    }).to_feather(os.path.join(data_dir, "d.feather"))
+
+
+def _phase_main(phase: str) -> int:
+    """Child body: run QUERIES, print one JSON line of results+counters."""
+    import pandas as pd
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.runtime import telemetry as tel
+
+    data_dir = os.environ["WARMSTART_DATA"]
+    c = Context()
+    for name in ("t", "d"):
+        c.create_table(name, pd.read_feather(
+            os.path.join(data_dir, f"{name}.feather")))
+    results = {}
+    for i, q in enumerate(QUERIES):
+        results[str(i)] = c.sql(q, return_futures=False).to_dict("list")
+    snap = tel.REGISTRY.counters()
+    print("WARMSTART_JSON " + json.dumps({
+        "results": results,
+        "compiles": snap["compiles"],
+        "stores": snap["program_store_stores"],
+        "hits": snap["program_store_hits"],
+        "rejects": snap["program_store_rejects"],
+        "errors": snap["program_store_errors"],
+    }))
+    return 0
+
+
+def _run_phase(phase: str, env: dict) -> dict:
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), f"--phase={phase}"],
+        capture_output=True, text=True, env=env, timeout=420)
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-3000:])
+        raise RuntimeError(f"{phase} phase exited rc={r.returncode}")
+    for line in r.stdout.splitlines():
+        if line.startswith("WARMSTART_JSON "):
+            return json.loads(line[len("WARMSTART_JSON "):])
+    sys.stderr.write(r.stdout[-2000:] + r.stderr[-2000:])
+    raise RuntimeError(f"{phase} phase emitted no result line")
+
+
+def _check_tiered_first_arrival() -> int:
+    """In-process: empty store, slowed compile — the first arrival must be
+    served on the eager tier without blocking on the build."""
+    import pandas as pd
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.physical import compiled
+    from dask_sql_tpu.runtime import telemetry as tel
+
+    os.environ["DSQL_TIERED"] = "1"
+    os.environ.pop("DSQL_PROGRAM_STORE", None)
+
+    data_dir = os.environ["WARMSTART_DATA"]
+    frame = pd.read_feather(os.path.join(data_dir, "t.feather"))
+    c = Context()
+    c.create_table("t", frame)
+    q = "SELECT k, SUM(v) AS s, COUNT(*) AS n FROM t GROUP BY k ORDER BY k"
+    oracle = (frame.groupby("k").agg(s=("v", "sum"), n=("v", "size"))
+              .reset_index().sort_values("k", ignore_index=True))
+
+    # eager-tier latency baseline for the bound below
+    os.environ["DSQL_COMPILE"] = "0"
+    t0 = time.perf_counter()
+    c.sql(q, return_futures=False)
+    eager_sec = time.perf_counter() - t0
+    del os.environ["DSQL_COMPILE"]
+
+    delay_s = 5.0
+    real_build = compiled._build
+
+    def slow_build(*a, **k):
+        time.sleep(delay_s)
+        return real_build(*a, **k)
+
+    compiled._build = slow_build
+    try:
+        c0 = tel.REGISTRY.counters()
+        t0 = time.perf_counter()
+        out = c.sql(q, return_futures=False)
+        first_sec = time.perf_counter() - t0
+        served = tel.REGISTRY.get("served_eager_while_compiling") \
+            - c0["served_eager_while_compiling"]
+        bg_done_at_return = tel.REGISTRY.get("background_compiles_done") \
+            - c0["background_compiles_done"]
+        if served < 1:
+            return fail("first arrival was not served on the eager tier")
+        if bg_done_at_return:
+            return fail("background compile finished before the eager "
+                        "answer returned — the tier gate did not overlap")
+        if first_sec >= delay_s:
+            return fail(f"first arrival ({first_sec:.2f}s) blocked on the "
+                        f"{delay_s:.0f}s compile")
+        bound = max(3.0 * eager_sec + 2.0, 4.0)
+        if first_sec > bound:
+            return fail(f"first arrival {first_sec:.2f}s exceeds the "
+                        f"eager-tier bound {bound:.2f}s "
+                        f"(eager baseline {eager_sec:.2f}s)")
+        got = out.sort_values("k", ignore_index=True)
+        if not (got["k"].tolist() == oracle["k"].tolist()
+                and all(abs(a - b) < 1e-6 for a, b in
+                        zip(got["s"], oracle["s"]))):
+            return fail("eager-tier answer does not match the oracle")
+        # the background compile must land; the next arrival runs compiled
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if tel.REGISTRY.get("background_compiles_done") \
+                    - c0["background_compiles_done"] >= 1:
+                break
+            time.sleep(0.1)
+        else:
+            return fail("background compile never landed")
+    finally:
+        compiled._build = real_build
+    c1 = tel.REGISTRY.counters()
+    c.sql(q, return_futures=False)
+    served2 = tel.REGISTRY.get("served_eager_while_compiling") \
+        - c1["served_eager_while_compiling"]
+    hits = tel.REGISTRY.get("hits") - c1["hits"]
+    if served2 != 0 or hits < 1:
+        return fail(f"second arrival did not run compiled "
+                    f"(served_eager={served2}, hits={hits})")
+    print(f"tiered: first arrival {first_sec:.2f}s on the eager tier "
+          f"(eager baseline {eager_sec:.2f}s, compile delayed {delay_s:.0f}s"
+          f"); second arrival compiled")
+    return 0
+
+
+def main() -> int:
+    workdir = tempfile.mkdtemp(prefix="warmstart_smoke_")
+    data_dir = os.path.join(workdir, "data")
+    store_dir = os.path.join(workdir, "programs")
+    os.makedirs(data_dir)
+    os.environ["WARMSTART_DATA"] = data_dir
+    _write_data(data_dir)
+
+    base_env = dict(os.environ,
+                    JAX_PLATFORMS="cpu",
+                    WARMSTART_DATA=data_dir,
+                    DSQL_PROGRAM_STORE=store_dir,
+                    DSQL_RESULT_CACHE_MB="0",
+                    DSQL_MAX_CONCURRENT_QUERIES="0",
+                    DSQL_TIERED="0",
+                    DSQL_STAGE_HEAVY="1")
+    base_env.pop("DSQL_FAULT_INJECT", None)
+
+    print("== populate process (cold store) ==")
+    t0 = time.perf_counter()
+    populate = _run_phase("populate", base_env)
+    print(f"populate: compiles={populate['compiles']} "
+          f"stores={populate['stores']} ({time.perf_counter() - t0:.1f}s)")
+    if populate["compiles"] < 1:
+        return fail("populate process compiled nothing")
+    if populate["stores"] < populate["compiles"]:
+        return fail(f"only {populate['stores']} of {populate['compiles']} "
+                    "compiled programs were persisted")
+
+    print("== warm process (fresh interpreter, populated store) ==")
+    t0 = time.perf_counter()
+    warm = _run_phase("warm", base_env)
+    warm_sec = time.perf_counter() - t0
+    print(f"warm: compiles={warm['compiles']} hits={warm['hits']} "
+          f"({warm_sec:.1f}s)")
+    if warm["compiles"] != 0:
+        return fail(f"warm process paid {warm['compiles']} XLA compiles — "
+                    "the store did not serve it")
+    if warm["hits"] < 1:
+        return fail("warm process recorded no program_store_hits")
+    if warm["rejects"] or warm["errors"]:
+        return fail(f"warm process saw rejects={warm['rejects']} "
+                    f"errors={warm['errors']}")
+    if warm["results"] != populate["results"]:
+        return fail("warm-process results differ from populate-process "
+                    "results")
+
+    print("== tiered first arrival (empty store, slowed compile) ==")
+    rc = _check_tiered_first_arrival()
+    if rc:
+        return rc
+
+    print("warmstart smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    phase = next((a.split("=", 1)[1] for a in sys.argv[1:]
+                  if a.startswith("--phase=")), None)
+    if phase:
+        sys.exit(_phase_main(phase))
+    sys.exit(main())

@@ -116,6 +116,22 @@ def _quarantine_off(request, monkeypatch):
     yield
 
 
+@pytest.fixture(autouse=True)
+def _tiering_off(request, monkeypatch):
+    """Tiered execution (physical/compiled.py, on by default in
+    production) would answer every COLD query on the eager tier while the
+    programs compile in the background — which would break every suite
+    that asserts compiled-path usage or counts compiles synchronously.
+    Mirroring the cache/scheduler/quarantine pins: off by default, armed
+    explicitly by the dedicated tiered/program-store suites, and
+    scripts/warmstart_smoke.py gates the production-default path."""
+    name = request.module.__name__
+    if "tiered" not in name and "program_store" not in name:
+        monkeypatch.setenv("DSQL_TIERED", "0")
+        monkeypatch.delenv("DSQL_PROGRAM_STORE", raising=False)
+    yield
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bounded_executable_lifetime():
     yield
@@ -124,6 +140,9 @@ def _bounded_executable_lifetime():
     compiled._cache.clear()
     compiled._learned_caps.clear()
     compiled._runtime_eager.clear()
+    with compiled._tier_lock:
+        compiled._tier_done.clear()
+        compiled._tier_inflight.clear()
     result_cache.get_cache().clear()
     faults.reset()
     jax.clear_caches()
