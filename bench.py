@@ -519,6 +519,53 @@ def _stage_main():
                 emit({"burst_fail": True, "error": repr(e)[:200]})
             finally:
                 os.environ["DSQL_MAX_CONCURRENT_QUERIES"] = "0"
+
+        # ESTIMATE-ERROR journal: for every measured query, the byte error
+        # of the scan-bytes heuristic vs the flight recorder's measured
+        # history against the EWMA'd actual working set — the evidence that
+        # the feedback loop shrinks memory-broker reservations.  Envelope-
+        # level admission estimates (est_source from the burst pass, the
+        # only scheduler-armed window) land alongside.
+        if measured and left() > 10:
+            try:
+                from dask_sql_tpu.runtime import flight_recorder as _fr
+                from dask_sql_tpu.runtime import scheduler as _sched
+                from dask_sql_tpu.runtime import telemetry as _tl
+                from dask_sql_tpu.sql.parser import parse_sql as _ps
+                if _fr.enabled():
+                    err = {"heuristic": [], "history": []}
+                    for qid in sorted(measured):
+                        plan = c._get_plan(_ps(QUERIES[qid])[0].query)
+                        fp = _fr.plan_fingerprint(plan, c)
+                        st = _fr.get_stats(fp) if fp else None
+                        actual = float((st or {}).get("bytes") or 0.0)
+                        if actual <= 0:
+                            continue
+                        heur = float(_sched.estimate_plan_bytes(plan, c))
+                        err["heuristic"].append(
+                            abs(heur - actual) / actual)
+                        hist = _fr.plan_history_bytes(plan, c)
+                        if hist:
+                            err["history"].append(
+                                abs(hist - actual) / actual)
+                    by_src = {}
+                    for ev in _fr.read_events(kind="query"):
+                        src = ev.get("est_source")
+                        m = ev.get("measured_bytes") or 0
+                        if src and m > 0 and ev.get("est_bytes"):
+                            by_src.setdefault(src, []).append(
+                                abs(ev["est_bytes"] - m) / m)
+                    emit({"estimate_error": {
+                              k: round(sum(v) / len(v), 4) if v else None
+                              for k, v in err.items()},
+                          "estimate_error_admitted": {
+                              k: round(sum(v) / len(v), 4)
+                              for k, v in by_src.items()},
+                          "estimate_from_history":
+                              _tl.REGISTRY.get("estimate_from_history")})
+            except Exception as e:
+                emit({"estimate_error_fail": True,
+                      "error": repr(e)[:200]})
     finally:
         # stage_done must survive anything the loops above throw: it
         # carries the compile stats and memory evidence for the artifact
@@ -620,6 +667,7 @@ def main():
         warm_hits = {}
         bursts = []
         first_arrival, restart_times, restart_info = {}, {}, {}
+        est_err, est_err_admitted, est_from_hist = {}, {}, None
         load_sec = warmup_sec = 0.0
         try:
             with open(state["progress"]) as f:
@@ -663,6 +711,11 @@ def main():
                         restart_times[rec["restart_q"]] = rec["sec"]
                     elif rec.get("restart_done"):
                         restart_info = rec
+                    elif "estimate_error" in rec:
+                        est_err = rec["estimate_error"] or {}
+                        est_err_admitted = \
+                            rec.get("estimate_error_admitted") or {}
+                        est_from_hist = rec.get("estimate_from_history")
                     elif "warm_start" in rec:
                         started.add(rec["warm_start"])
                     elif "warm_fail" in rec:
@@ -786,6 +839,14 @@ def main():
                                   if b.get("outcome") == "rejected")
                               / len(bursts), 3) if bursts else None),
                     "burst_queue_time_ms": burst_queue,
+                    # estimate-feedback evidence (runtime/flight_recorder):
+                    # mean |estimated - actual| / actual working-set bytes
+                    # per estimate source — "history" shrinking under
+                    # "heuristic" is the loop closing — plus admission-time
+                    # envelope errors and the estimate_from_history count
+                    "estimate_error_by_source": est_err or None,
+                    "estimate_error_admitted": est_err_admitted or None,
+                    "estimate_from_history": est_from_hist,
                     "gen_sec": round(state["gen_sec"], 1),
                     "load_sec": round(load_sec, 1),
                     "warmup_compile_sec": round(warmup_sec, 1),
@@ -946,6 +1007,12 @@ def main():
     # primed by an earlier run on this host starts warm outright
     env_base.setdefault("DSQL_PROGRAM_STORE",
                         os.path.join(cache_root, "programs"))
+    # flight recorder (runtime/flight_recorder.py): the measurement child
+    # leaves per-query envelopes + operator statistics, so the burst pass
+    # estimates its admissions from MEASURED history and the child can
+    # journal estimate-vs-actual byte error against the scan-bytes guess
+    env_base.setdefault("DSQL_HISTORY_FILE",
+                        os.path.join(cache_root, "history.jsonl"))
 
     def journal_state():
         """(measured set, warm-failure counts) from the progress file."""

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import os
 from typing import Any, Callable, List, Optional, Tuple, Union
 
 from .datacontainer import FunctionDescription, SchemaContainer, TableEntry
@@ -63,6 +64,10 @@ class Context:
         # serve a stale cached result
         self._table_epochs: dict = {}
         self._epoch_counter = itertools.count(1)
+        # the lazily-materialized builtin "system" schema sentinel
+        # (runtime/system_tables.py): created on first system.* resolution;
+        # a user schema literally named "system" shadows it
+        self._system_schema: Optional[SchemaContainer] = None
         # register default input plugins (reference context.py:113-119 order)
         for plugin in (DeviceTableInputPlugin(), PandasLikeInputPlugin(),
                        DictInputPlugin(), ArrowInputPlugin(), HiveInputPlugin(),
@@ -382,6 +387,18 @@ class Context:
                 _tel.annotate(result_cache="hit", result_cache_tier=tier)
                 return table
             _tel.inc("result_cache_misses")
+        # flight recorder (runtime/flight_recorder.py): stamp the canonical
+        # plan fingerprint on the execute span so the completion envelope
+        # and the EWMA statistics history key to it.  Env-gated BEFORE the
+        # import — with the recorder off this path allocates nothing.
+        if os.environ.get("DSQL_HISTORY_FILE"):
+            try:
+                from .runtime import flight_recorder as _fr
+                fp = _fr.plan_fingerprint(plan, self)
+                if fp is not None:
+                    _tel.annotate(plan_fp=fp)
+            except Exception:
+                logger.debug("plan fingerprint failed", exc_info=True)
         # whole-plan jit (one device dispatch per query); falls back to
         # the eager per-op executor for plan shapes outside its subset
         from .physical.compiled import try_execute_compiled
@@ -458,6 +475,10 @@ class Context:
 
     def resolve_table(self, parts: List[str]):
         """Binder hook: (schema, table, fields, view_plan) or None."""
+        if len(parts) == 2 and parts[0] == "system":
+            resolved = self._resolve_system_table(parts[1])
+            if resolved is not None:
+                return resolved
         candidates = []
         if len(parts) == 1:
             candidates.append((self.schema_name, parts[0]))
@@ -478,6 +499,32 @@ class Context:
                     return schema_name, table_name.lower(), fields, None
                 return schema_name, table_name.lower(), list(entry.plan.schema), entry.plan
         return None
+
+    def _resolve_system_table(self, table_name: str):
+        """Lazily bind ``system.<name>`` to a FRESH snapshot of live engine
+        state (runtime/system_tables.py).  The snapshot Table is registered
+        into a sentinel SchemaContainer so the executor's ordinary
+        schema[..].tables[..] lookup scans the exact rows the binder saw;
+        the next resolution rebuilds it.  A user-created schema named
+        "system" takes precedence (None falls through to normal lookup);
+        catalog epochs are never touched — system scans are marked volatile
+        by the result cache instead (result_cache._canon_rel)."""
+        existing = self.schema.get("system")
+        if existing is not None and existing is not self._system_schema:
+            return None  # user schema shadows the builtin
+        from .runtime import system_tables as _sys
+
+        name = table_name.lower()
+        tbl = _sys.build(name, self)
+        if tbl is None:
+            return None
+        if self._system_schema is None:
+            self._system_schema = SchemaContainer("system")
+        self.schema["system"] = self._system_schema
+        self._system_schema.tables[name] = TableEntry(table=tbl)
+        fields = [Field(n, c.stype)
+                  for n, c in zip(tbl.names, tbl.columns)]
+        return "system", name, fields, None
 
     def get_function(self, name: str) -> Optional[FunctionDescription]:
         for schema_name in (self.schema_name, self.DEFAULT_SCHEMA_NAME):

@@ -2541,6 +2541,49 @@ def _compile_workers(n_stages: Optional[int] = None) -> int:
     return max(1, w)
 
 
+def _record_stage_stats(st, idx: int, out: Table, query_fp: str,
+                        stage_rows: Dict[int, int], wall_ms: float) -> None:
+    """One flight-recorder stats record per executed stage (callers gate
+    on DSQL_HISTORY_FILE — the disabled path never reaches here).
+
+    The digest is the stage's boundary-table content digest
+    (_stage_table_name) — the canonical stage fingerprint the EWMA history
+    keys on; the root stage (no boundary) keys under the query fingerprint.
+    Capacity is the padded power-of-2 class _pad_capacity would
+    materialize, so measured rows vs capacity shows the padding waste."""
+    try:
+        from ..runtime import flight_recorder as _fr
+
+        rows_out = int(out.num_rows)
+        stage_rows[idx] = rows_out
+        rows_in = sum(stage_rows.get(d, 0) for d in st.deps)
+        nbytes = 0
+        for c in out.columns:
+            nbytes += int(getattr(c.data, "nbytes", 0))
+            if getattr(c, "mask", None) is not None:
+                nbytes += int(getattr(c.mask, "nbytes", 0))
+        digest = (st.scan.table_name if st.scan is not None
+                  else f"root:{query_fp}")
+        capacity = 1 << max((max(rows_out, 1) - 1).bit_length(), 6)
+        # device time, when DSQL_TIME_DEVICE split it out onto child spans
+        device_ms = 0.0
+        sp = _tel.current_span()
+        if sp is not None:
+            for s in sp.walk():
+                device_ms += float(s.attrs.get("device_ms", 0.0) or 0.0)
+        # the span carries the measurements too: record_query sums
+        # stage_bytes into the query's measured working set at close
+        _tel.annotate(stage_digest=digest, stage_rows_in=rows_in,
+                      stage_rows_out=rows_out, stage_capacity=capacity,
+                      stage_bytes=nbytes)
+        _fr.record_stage(digest, rows_in=rows_in, rows_out=rows_out,
+                         capacity=capacity, nbytes=nbytes, wall_ms=wall_ms,
+                         device_ms=device_ms or None, query_fp=query_fp)
+    except Exception:  # recording must never fail a stage
+        _tel.inc("history_errors")
+        logger.debug("stage stat capture failed", exc_info=True)
+
+
 def _execute_stage_graph(graph: StageGraph, context, query_fp: str,
                          split_limit: Optional[int]) -> Optional[Table]:
     """Run a stage DAG: dependencies first, independent stages concurrently.
@@ -2567,6 +2610,10 @@ def _execute_stage_graph_inner(graph: StageGraph, context, query_fp: str,
     rt = _res.current()
     tel_trace = _tel.current_trace()
     tel_parent = _tel.current_span()
+    # measured per-stage output rows (flight recorder only): a stage's
+    # dependencies complete before it runs, so dependents read their
+    # inputs' real row counts here.  Plain dict ops — GIL-atomic.
+    stage_rows: Dict[int, int] = {}
 
     def run_stage_once(idx: int, attempt: int) -> Optional[Table]:
         _tel.inc("stage_execs")
@@ -2613,7 +2660,14 @@ def _execute_stage_graph_inner(graph: StageGraph, context, query_fp: str,
             while True:
                 _res.check("stage_exec")
                 try:
-                    return run_stage_once(idx, attempt)
+                    t0s = time.perf_counter()
+                    out = run_stage_once(idx, attempt)
+                    if out is not None and \
+                            os.environ.get("DSQL_HISTORY_FILE"):
+                        _record_stage_stats(
+                            stages[idx], idx, out, query_fp, stage_rows,
+                            (time.perf_counter() - t0s) * 1e3)
+                    return out
                 except (KeyboardInterrupt, SystemExit):
                     raise
                 except Exception as e:
@@ -2840,18 +2894,33 @@ def _background_compile(plan: RelNode, context, base_key) -> None:
     program cache, quarantine interplay, and the persistent store all
     populate the same way a foreground compile would."""
     _tier_local.bg = True
+    trace = None
     try:
         with _bg_sem:
+            # a daemon thread has fresh thread-locals: without its own
+            # trace these compile spans ran OUTSIDE any QueryTrace and
+            # never reached DSQL_CHROME_TRACE_DIR.  A dedicated
+            # background_compile trace captures them; close_background_trace
+            # exports it without counting a query or arming the slow log.
+            trace = _tel.QueryTrace(f"<background-compile:{base_key[0][:48]}>")
+            trace.root.name = "background_compile"
             try:
-                try_execute_compiled(plan, context)
+                with _tel.scoped(trace, trace.root):
+                    try_execute_compiled(plan, context)
                 _tel.inc("background_compiles_done")
             except (KeyboardInterrupt, SystemExit):
                 raise
             except BaseException as e:
+                trace.root.attrs["error"] = type(e).__name__
                 _tel.inc("background_compile_errors")
                 logger.warning("background compile failed (%s: %s)",
                                type(e).__name__, str(e)[:200])
     finally:
+        if trace is not None:
+            try:
+                _tel.close_background_trace(trace)
+            except Exception:  # pragma: no cover - telemetry is advisory
+                logger.debug("background trace close failed", exc_info=True)
         _tier_local.bg = False
         with _tier_lock:
             _tier_inflight.discard(base_key)
@@ -2885,6 +2954,43 @@ def _tier_serve_eager(plan: RelNode, context, base_key, budget: int,
                       args=(plan, context, base_key),
                       name="dsql-bg-compile", daemon=True).start()
     return True
+
+
+def inflight_background_compiles() -> list:
+    """Plan fingerprints currently compiling in background daemon threads
+    (for ``system.active`` / ``/v1/engine``)."""
+    with _tier_lock:
+        return [k[0] for k in _tier_inflight]
+
+
+def tier_probe(plan: RelNode, context) -> str:
+    """Predict (without executing) which tier would answer this plan NOW:
+    ``eager`` (not compilable / compile off), ``compiled`` (programs warm),
+    ``eager-compiling`` (cold + tiering serves eager while building), or
+    ``compiled-cold`` (tiering off: the arrival pays the compile)."""
+    if os.environ.get("DSQL_COMPILE", "1") == "0":
+        return "eager"
+    from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
+
+    scans: list = []
+    try:
+        plan_fp = _fp_plan(plan, context, scans)
+    except Unsupported:
+        return "eager"
+    base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()))
+    hint = _learned_caps_get(base_key).get("__split__")
+    budget = stage_budget(int(hint) if hint is not None else None)
+    try:
+        if _programs_ready(plan, context, base_key, budget):
+            return "compiled"
+    except Exception:  # pragma: no cover - probe must never fail a query
+        logger.debug("tier probe failed", exc_info=True)
+        return "eager"
+    with _tier_lock:
+        inflight = base_key in _tier_inflight
+    if inflight or _tiering_enabled():
+        return "eager-compiling"
+    return "compiled-cold"
 
 
 def try_execute_compiled(plan: RelNode, context,

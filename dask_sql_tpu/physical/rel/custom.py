@@ -266,6 +266,15 @@ def _explain_analyze(plan, context):
         cache_line = (f"-- cache: hit tier={tier}" if tier is not None
                       else "-- cache: miss")
 
+    # execution-tier probe BEFORE executing, mirroring the cache probe:
+    # the analyzed run itself is always eager (per-node instrumentation),
+    # so report what tier a plain run would answer on
+    try:
+        from ..compiled import tier_probe
+        exec_tier = tier_probe(plan, context)
+    except Exception:
+        exec_tier = "eager"
+
     snap0 = _tel.REGISTRY.counters()
     t0 = _time.perf_counter()
     with _tel.record_nodes() as rec:
@@ -308,6 +317,12 @@ def _explain_analyze(plan, context):
     lines.append(f"-- analyzed: wall={wall_ms:.3f}ms rows_out={rows_out} "
                  f"nodes={len(rec.records)}")
     lines.append(cache_line)
+    store_hits = (snap1.get("program_store_hits", 0)
+                  - snap0.get("program_store_hits", 0))
+    tier_line = f"-- tier: {exec_tier}"
+    if store_hits:
+        tier_line += f" program_store_hits=+{store_hits}"
+    lines.append(tier_line)
     delta = {k: snap1[k] - snap0.get(k, 0) for k in snap1
              if snap1[k] != snap0.get(k, 0)}
     if delta:

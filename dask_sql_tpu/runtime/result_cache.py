@@ -151,6 +151,14 @@ def _canon_rel(rel, acc: _Canon) -> None:
     t = type(rel).__name__
     schema = ";".join(f"{f.name}:{f.stype.name}" for f in rel.schema)
     if isinstance(rel, LogicalTableScan):
+        if rel.schema_name == "system":
+            # system tables are views over live engine state (and the
+            # flight-recorder file): never cacheable, and they must not
+            # occupy result-cache budget or bump catalog epochs.  A user
+            # schema literally named "system" shadows the builtin in
+            # resolution but still pays this exemption — acceptable cost
+            # for a reserved name.
+            acc.volatile = True
         if rel.schema_name != _SPLIT_SCHEMA:
             acc.scans.append((rel.schema_name, rel.table_name))
         # a __split__ boundary name is already a content digest of its
@@ -464,6 +472,14 @@ class ResultCache:
                 "device_budget": self.device_budget(),
                 "host_budget": self.host_budget(),
             }
+
+    def entries_snapshot(self) -> List[dict]:
+        """Per-entry view for ``system.cache`` (LRU order, oldest first)."""
+        with self._lock:
+            return [{"key": e.key, "tier": e.tier, "nbytes": int(e.nbytes),
+                     "hits": int(e.hits),
+                     "tables": ",".join(f"{s}.{t}" for s, t in e.tables)}
+                    for e in self._entries.values()]
 
     # -- internals (lock held) ---------------------------------------------
     def _unaccount(self, e: _Entry) -> None:

@@ -81,7 +81,7 @@ import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from . import faults as _faults, telemetry as _tel
 from . import resilience as _res
@@ -191,6 +191,25 @@ def estimate_plan_bytes(plan, context) -> int:
             mult *= _OP_MULTIPLIERS.get(t, 1.0)
         stack.extend(getattr(rel, "inputs", ()) or ())
     return int(scan_bytes * min(mult, _MULTIPLIER_CAP)) + _MIN_ESTIMATE
+
+
+def estimate_working_set(plan, context) -> "Tuple[int, str]":
+    """(bytes, source) for the admission reservation: MEASURED history
+    first, shape heuristic as fallback.
+
+    When the flight recorder (runtime/flight_recorder.py) has an EWMA
+    entry for this plan's canonical fingerprint, the reservation comes
+    from bytes the engine actually touched on previous runs of the same
+    shape (× DSQL_HISTORY_HEADROOM) instead of the scan-bytes×multiplier
+    guess — counter ``estimate_from_history`` tallies those.  Never-seen
+    plans (and a disabled recorder) keep the heuristic."""
+    from . import flight_recorder as _fr
+
+    hist = _fr.plan_history_bytes(plan, context)
+    if hist is not None:
+        _tel.inc("estimate_from_history")
+        return max(int(hist), _MIN_ESTIMATE), "history"
+    return estimate_plan_bytes(plan, context), "heuristic"
 
 
 # ---------------------------------------------------------------------------
@@ -430,6 +449,16 @@ class WorkloadManager:
         with self._lock:
             return self._running
 
+    def waiting_snapshot(self) -> "List[dict]":
+        """Per-ticket view of the admission queue (system.active /
+        GET /v1/engine): priority class, time waited, requested bytes."""
+        now = time.monotonic()
+        with self._lock:
+            return [{"priority": p,
+                     "waitedMillis": round((now - t.enqueued_at) * 1e3, 1),
+                     "estBytes": int(t.est_bytes)}
+                    for p in PRIORITIES for t in self._waiting[p]]
+
     # -- seats (server POST-time pre-claims) --------------------------------
     def claim_seat(self, priority: str) -> Optional[Seat]:
         """Claim a place in line at submit time; raises AdmissionRejected
@@ -668,16 +697,18 @@ class WorkloadManager:
             (seat.priority if seat is not None else None) or \
             default_priority()
         est = 0
+        est_src = "none"
         if plan is not None and context is not None:
             try:
-                est = estimate_plan_bytes(plan, context)
+                est, est_src = estimate_working_set(plan, context)
             except Exception:      # estimator must never fail a query
                 logger.debug("working-set estimate failed", exc_info=True)
-                est = _MIN_ESTIMATE
+                est, est_src = _MIN_ESTIMATE, "floor"
         with _tel.span("queued", priority=pr):
             ticket = self.acquire(pr, est, seat=seat)
             _tel.annotate(queued_ms=round(ticket.queued_ms or 0.0, 3),
-                          reserved_bytes=ticket.reserved_bytes)
+                          reserved_bytes=ticket.reserved_bytes,
+                          est_bytes=int(est), est_source=est_src)
         rt = _res.current()
         backoff0 = rt.backoff_s if rt is not None else 0.0
         _tls.ticket = ticket

@@ -1,0 +1,186 @@
+"""The read-only ``system`` schema: the engine introspected through its own
+SQL.
+
+Six virtual tables, each BUILT FRESH at name-resolution time
+(context.resolve_table) from live process state and the flight-recorder
+ring — never persisted in the catalog, never cacheable
+(result_cache._canon_rel marks ``system`` scans volatile so they can't
+occupy result-cache budget or interact with catalog epochs):
+
+- ``system.queries``     persistent query history (the JSONL ring)
+- ``system.active``      in-flight queries + scheduler queue + background
+                         compiles, with phase/tier/per-stage progress
+- ``system.metrics``     the telemetry registry (counters + gauges)
+- ``system.cache``       result-cache entries with tier/bytes/hits
+- ``system.quarantine``  standing compiler-crash verdicts
+- ``system.programs``    persistent program-store index
+
+Every table has a FIXED column schema with explicit dtypes so an empty
+engine still binds and executes ``SELECT * FROM system.queries`` — object
+columns stay object, numeric columns stay float64/int64 at zero rows.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..table import Table
+
+TABLE_NAMES = ("queries", "active", "metrics", "cache", "quarantine",
+               "programs")
+
+
+def _col(rows: List[dict], key: str, dtype, default):
+    vals = []
+    for r in rows:
+        v = r.get(key)
+        vals.append(default if v is None else v)
+    if dtype is object:
+        if not vals:
+            # an empty object array crashes host_encode_numpy's null scan;
+            # an empty unicode array types as VARCHAR just the same
+            return np.array([], dtype="U1")
+        return np.array([str(v) for v in vals], dtype=object)
+    return np.array(vals, dtype=dtype)
+
+
+def _queries() -> Table:
+    from . import flight_recorder as _fr
+
+    rows = _fr.read_events(kind="query")
+    return Table.from_pydict({
+        "unix": _col(rows, "unix", np.float64, 0.0),
+        "pid": _col(rows, "pid", np.int64, 0),
+        "query": _col(rows, "query", object, ""),
+        "outcome": _col(rows, "outcome", object, ""),
+        "error": _col(rows, "error", object, ""),
+        "wall_ms": _col(rows, "wall_ms", np.float64, 0.0),
+        "tier": _col(rows, "tier", object, ""),
+        "priority": _col(rows, "priority", object, ""),
+        "cache_hit": _col(rows, "cache_hit", np.bool_, False),
+        "rows_out": _col(rows, "rows_out", np.int64, 0),
+        "bytes_out": _col(rows, "bytes_out", np.int64, 0),
+        "measured_bytes": _col(rows, "measured_bytes", np.int64, 0),
+        "est_bytes": _col(rows, "est_bytes", np.int64, 0),
+        "est_source": _col(rows, "est_source", object, ""),
+        "queued_ms": _col(rows, "queued_ms", np.float64, 0.0),
+        "plan_fp": _col(rows, "plan_fp", object, ""),
+    })
+
+
+def _active() -> Table:
+    import os
+
+    from ..physical import compiled as _compiled
+    from . import flight_recorder as _fr
+    from . import scheduler as _sched
+
+    rows: List[dict] = []
+    for a in _fr.active_snapshot():
+        rows.append({"state": "running", "query": a["query"],
+                     "phase": a["phase"], "tier": a["tier"],
+                     "priority": a["priority"],
+                     "elapsed_ms": a["elapsedMillis"], "est_bytes": 0,
+                     "stages_done": a["stagesDone"],
+                     "stages_total": a["stagesTotal"], "pid": a["pid"]})
+    for w in _sched.get_manager().waiting_snapshot():
+        rows.append({"state": "queued", "query": "", "phase": "queued",
+                     "tier": "", "priority": w["priority"],
+                     "elapsed_ms": w["waitedMillis"],
+                     "est_bytes": w["estBytes"], "stages_done": 0,
+                     "stages_total": 0, "pid": os.getpid()})
+    for fp in _compiled.inflight_background_compiles():
+        rows.append({"state": "bg-compile",
+                     "query": f"<background-compile:{fp[:32]}>",
+                     "phase": "compile", "tier": "background",
+                     "priority": "", "elapsed_ms": 0.0, "est_bytes": 0,
+                     "stages_done": 0, "stages_total": 0,
+                     "pid": os.getpid()})
+    return Table.from_pydict({
+        "state": _col(rows, "state", object, ""),
+        "query": _col(rows, "query", object, ""),
+        "phase": _col(rows, "phase", object, ""),
+        "tier": _col(rows, "tier", object, ""),
+        "priority": _col(rows, "priority", object, ""),
+        "elapsed_ms": _col(rows, "elapsed_ms", np.float64, 0.0),
+        "est_bytes": _col(rows, "est_bytes", np.int64, 0),
+        "stages_done": _col(rows, "stages_done", np.int64, 0),
+        "stages_total": _col(rows, "stages_total", np.int64, 0),
+        "pid": _col(rows, "pid", np.int64, 0),
+    })
+
+
+def _metrics() -> Table:
+    from . import telemetry as _tel
+
+    snap = _tel.REGISTRY.snapshot()
+    rows = [{"name": k, "kind": "counter", "value": float(v)}
+            for k, v in sorted(snap["counters"].items())]
+    rows += [{"name": k, "kind": "gauge", "value": float(v)}
+             for k, v in sorted(snap["gauges"].items())]
+    return Table.from_pydict({
+        "name": _col(rows, "name", object, ""),
+        "kind": _col(rows, "kind", object, ""),
+        "value": _col(rows, "value", np.float64, 0.0),
+    })
+
+
+def _cache() -> Table:
+    from . import result_cache as _rc
+
+    rows = _rc.get_cache().entries_snapshot()
+    return Table.from_pydict({
+        "key": _col(rows, "key", object, ""),
+        "tier": _col(rows, "tier", object, ""),
+        "nbytes": _col(rows, "nbytes", np.int64, 0),
+        "hits": _col(rows, "hits", np.int64, 0),
+        "tables": _col(rows, "tables", object, ""),
+    })
+
+
+def _quarantine() -> Table:
+    from . import quarantine as _quar
+
+    rows = [{"key": k, **(e if isinstance(e, dict) else {})}
+            for k, e in sorted(_quar.get_store().entries().items())]
+    return Table.from_pydict({
+        "key": _col(rows, "key", object, ""),
+        "verdict": _col(rows, "verdict", object, ""),
+        "reason": _col(rows, "reason", object, ""),
+        "strikes": _col(rows, "strikes", np.int64, 0),
+        "at": _col(rows, "at", np.float64, 0.0),
+        "expires_at": _col(rows, "expires_at", np.float64, 0.0),
+    })
+
+
+def _programs() -> Table:
+    from . import program_store as _pstore
+
+    rows = [{"digest": d, **(e if isinstance(e, dict) else {})}
+            for d, e in sorted(_pstore.get_store().entries().items())]
+    return Table.from_pydict({
+        "digest": _col(rows, "digest", object, ""),
+        "nbytes": _col(rows, "bytes", np.int64, 0),
+        "used_at": _col(rows, "used_at", np.float64, 0.0),
+        "stored_at": _col(rows, "stored_at", np.float64, 0.0),
+    })
+
+
+_BUILDERS: Dict[str, object] = {
+    "queries": _queries,
+    "active": _active,
+    "metrics": _metrics,
+    "cache": _cache,
+    "quarantine": _quarantine,
+    "programs": _programs,
+}
+
+
+def build(name: str, context=None) -> Optional[Table]:
+    """A fresh snapshot Table for ``system.<name>``, or None for unknown
+    names (the binder then reports the table as undefined)."""
+    builder = _BUILDERS.get(name.lower())
+    if builder is None:
+        return None
+    return builder()  # type: ignore[operator]

@@ -109,6 +109,12 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     # server boundary
     "server_queries", "server_query_errors", "server_cancels",
     "server_throttled", "server_drain_rejects",
+    # flight recorder (runtime/flight_recorder.py): persisted event-log
+    # appends / ring truncations / swallowed recording failures, and the
+    # memory-broker estimates served from MEASURED history instead of the
+    # scan-bytes×multiplier heuristic (scheduler.estimate_working_set)
+    "history_records", "history_truncations", "history_errors",
+    "estimate_from_history",
 )
 
 STABLE_HISTOGRAMS: Tuple[str, ...] = (
@@ -478,7 +484,8 @@ class QueryReport:
     under concurrency).  ``root``: the span tree."""
 
     __slots__ = ("query", "wall_ms", "phases", "counters", "root",
-                 "rows_out", "bytes_out", "started_unix", "cache", "tier")
+                 "rows_out", "bytes_out", "started_unix", "cache", "tier",
+                 "priority")
 
     def __init__(self, trace: QueryTrace):
         root = trace.root
@@ -515,6 +522,9 @@ class QueryReport:
         # "compiled" / "eager" / "eager-compiling" (served on the eager
         # tier while the stage programs build in the background)
         exec_tier: Optional[str] = None
+        # workload-manager class: the admission path stamps it on the
+        # queued span; None when the scheduler is disabled
+        priority: Optional[str] = None
         for s in root.walk():
             rc = s.attrs.get("result_cache")
             if rc == "hit":
@@ -527,7 +537,11 @@ class QueryReport:
             t = s.attrs.get("tier")
             if t is not None and exec_tier is None:
                 exec_tier = str(t)
+            if s.name == "queued" and priority is None:
+                p = s.attrs.get("priority")
+                priority = str(p) if p is not None else None
         self.tier = exec_tier
+        self.priority = priority
         self.cache = {"hit": hit, "tier": tier, "stored": stored,
                       "subplan_hits": subplan_hits,
                       "bytes": int(REGISTRY.get_gauge("result_cache_bytes")),
@@ -543,6 +557,7 @@ class QueryReport:
                 "counters": dict(self.counters),
                 "cache": dict(self.cache),
                 "tier": self.tier,
+                "priority": self.priority,
                 "rows_out": self.rows_out, "bytes_out": self.bytes_out,
                 "spans": self.root.to_dict()}
 
@@ -607,6 +622,38 @@ _chrome_counter = [0]
 _chrome_lock = threading.Lock()
 
 
+def _export_chrome_trace(report: QueryReport) -> None:
+    """Write the span tree as chrome://tracing JSON when
+    ``DSQL_CHROME_TRACE_DIR`` is armed; shared by the per-query close and
+    the background-compile daemon threads (close_background_trace)."""
+    trace_dir = os.environ.get("DSQL_CHROME_TRACE_DIR")
+    if not trace_dir:
+        return
+    try:
+        os.makedirs(trace_dir, exist_ok=True)
+        with _chrome_lock:
+            _chrome_counter[0] += 1
+            n = _chrome_counter[0]
+        path = os.path.join(
+            trace_dir, f"query_{os.getpid()}_{n:05d}.trace.json")
+        with open(path, "w") as f:
+            json.dump(report.to_chrome_trace(), f)
+    except OSError as e:  # telemetry must never fail the query
+        logger.debug("chrome trace export failed: %s", e)
+
+
+def close_background_trace(trace: QueryTrace) -> QueryReport:
+    """Close a NON-query trace (background compile daemon threads carry
+    their own — physical/compiled._background_compile): builds the report
+    and exports the chrome trace WITHOUT counting a query, arming the
+    slow-query log, or recording a history envelope."""
+    trace.root.t1 = time.perf_counter()
+    report = QueryReport(trace)
+    trace.report = report
+    _export_chrome_trace(report)
+    return report
+
+
 def _close_trace(trace: QueryTrace, error: Optional[BaseException]) -> None:
     trace.root.t1 = time.perf_counter()
     if error is not None:
@@ -626,25 +673,25 @@ def _close_trace(trace: QueryTrace, error: Optional[BaseException]) -> None:
     if slow_ms is not None and report.wall_ms >= slow_ms:
         REGISTRY.inc("slow_queries")
         logger.warning(
-            "slow query (%.0f ms >= DSQL_SLOW_QUERY_MS=%.0f): %s | phases: "
-            "%s | counters: %s",
+            "slow query (%.0f ms >= DSQL_SLOW_QUERY_MS=%.0f): %s | tier: %s "
+            "| cacheHit: %s | priority: %s | phases: %s | counters: %s",
             report.wall_ms, slow_ms, report.query.strip()[:500],
+            report.tier or "eager", bool(report.cache.get("hit")),
+            report.priority or "-",
             {k: round(v, 1) for k, v in sorted(report.phases.items())},
             dict(sorted(report.counters.items())))
 
-    trace_dir = os.environ.get("DSQL_CHROME_TRACE_DIR")
-    if trace_dir:
+    _export_chrome_trace(report)
+
+    # flight recorder (runtime/flight_recorder.py): the env gate keeps the
+    # disabled hot path at ONE dict lookup — no import, no lock
+    if os.environ.get("DSQL_HISTORY_FILE"):
         try:
-            os.makedirs(trace_dir, exist_ok=True)
-            with _chrome_lock:
-                _chrome_counter[0] += 1
-                n = _chrome_counter[0]
-            path = os.path.join(
-                trace_dir, f"query_{os.getpid()}_{n:05d}.trace.json")
-            with open(path, "w") as f:
-                json.dump(report.to_chrome_trace(), f)
-        except OSError as e:  # telemetry must never fail the query
-            logger.debug("chrome trace export failed: %s", e)
+            from . import flight_recorder as _fr
+            _fr.record_query(report, error)
+        except Exception:
+            REGISTRY.inc("history_errors")
+            logger.debug("flight recorder append failed", exc_info=True)
 
 
 @contextmanager
@@ -661,6 +708,15 @@ def trace_scope(query: str = ""):
     _tls.trace = trace
     _tls.span = trace.root
     _tls.exec_profile = {}
+    # live-query registry for system.active / GET /v1/engine — gated on the
+    # recorder's env knob so the disabled path allocates nothing
+    registered = False
+    if os.environ.get("DSQL_HISTORY_FILE"):
+        try:
+            from . import flight_recorder as _fr
+            registered = _fr.begin_query(trace)
+        except Exception:
+            logger.debug("flight recorder begin failed", exc_info=True)
     err: Optional[BaseException] = None
     try:
         yield trace
@@ -674,6 +730,11 @@ def trace_scope(query: str = ""):
             _close_trace(trace, err)
         except Exception:  # pragma: no cover - never mask the query result
             logger.exception("telemetry close failed")
+        if registered:
+            try:
+                _fr.end_query(trace)
+            except Exception:  # pragma: no cover - registry is advisory
+                logger.debug("flight recorder end failed", exc_info=True)
 
 
 # ---------------------------------------------------------------------------

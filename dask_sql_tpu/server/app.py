@@ -271,6 +271,72 @@ def _data_payload(table) -> list:
 
 
 # ---------------------------------------------------------------------------
+# GET /v1/engine: one live snapshot of the whole engine
+# ---------------------------------------------------------------------------
+
+def _engine_snapshot(state: "_AppState") -> dict:
+    """Everything an operator needs in one poll: in-flight queries with
+    per-stage progress (flight recorder's live registry), scheduler queue
+    depths, memory-ledger occupancy, cache tiers, quarantine verdicts,
+    program-store stats, and the history ring's location."""
+    from ..physical import compiled as _compiled
+    from ..runtime import flight_recorder as _fr
+    from ..runtime import program_store as _pstore
+    from ..runtime import quarantine as _quar
+    from ..runtime import result_cache as _rc
+
+    mgr = _sched.get_manager()
+    counters = _tel.REGISTRY.counters()
+    with state.lock:
+        server_queries = [
+            {"id": uid,
+             "state": ("FINISHED" if fut.done() else
+                       "QUEUED" if (state.query_info.get(uid) is not None
+                                    and state.query_info[uid].started is None)
+                       else "RUNNING")}
+            for uid, fut in state.future_list.items()]
+    pstore = _pstore.get_store()
+    qstore = _quar.get_store()
+    return {
+        "pid": os.getpid(),
+        "active": _fr.active_snapshot(),
+        "serverQueries": server_queries,
+        "scheduler": {
+            "enabled": mgr.enabled(),
+            "limit": mgr.limit(),
+            "queueDepth": mgr.queue_depth(),
+            "running": mgr.running_count(),
+            "waiting": mgr.waiting_snapshot(),
+            "draining": mgr.draining(),
+        },
+        "memory": {
+            "budgetBytes": mgr.ledger.budget(),
+            "reservedBytes": mgr.ledger.reserved_bytes(),
+        },
+        "cache": _rc.get_cache().stats(),
+        "quarantine": {
+            "enabled": qstore.enabled(),
+            "entries": len(qstore.entries()) if qstore.enabled() else 0,
+        },
+        "programStore": {
+            "enabled": pstore.enabled(),
+            "entries": len(pstore.entries()) if pstore.enabled() else 0,
+            "bytes": pstore.total_bytes() if pstore.enabled() else 0,
+        },
+        "backgroundCompiles": {
+            "inflight": len(_compiled.inflight_background_compiles()),
+            "done": int(counters.get("background_compiles_done", 0)),
+            "errors": int(counters.get("background_compile_errors", 0)),
+        },
+        "history": {
+            "enabled": _fr.enabled(),
+            "file": _fr.history_path() or "",
+            "records": int(counters.get("history_records", 0)),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
 # server
 # ---------------------------------------------------------------------------
 
@@ -409,7 +475,7 @@ def _make_handler(state: _AppState, base_url: str):
             self.end_headers()
             self.wfile.write(body)
 
-        # GET /metrics  |  GET /v1/empty  |  GET /v1/status/{uuid}
+        # GET /metrics | GET /v1/engine | GET /v1/empty | GET /v1/status/{uuid}
         def do_GET(self):
             if self.path.rstrip("/").split("?")[0] == "/metrics":
                 # Prometheus text exposition of the engine's telemetry
@@ -422,6 +488,15 @@ def _make_handler(state: _AppState, base_url: str):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+                return
+            if self.path.rstrip("/").split("?")[0] == "/v1/engine":
+                try:
+                    payload = _engine_snapshot(state)
+                except Exception:
+                    logger.exception("/v1/engine snapshot failed")
+                    self._send(500, {"error": "snapshot failed"})
+                    return
+                self._send(200, payload)
                 return
             if self.path.rstrip("/") == "/v1/empty":
                 self._send(200, {

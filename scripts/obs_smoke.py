@@ -15,15 +15,25 @@ query logs, chrome-trace export into a temp dir); the gate asserts
   3. ``GET /metrics`` on a live server is non-empty prometheus text whose
      counters cover the engine's work (compiles+hits >= query count) and
      never decrease across queries;
-  4. the chrome-trace export produced one well-formed JSON per query.
+  4. the chrome-trace export produced one well-formed JSON per query;
+  5. the flight recorder survives the process boundary: queries run in a
+     CHILD process land in ``DSQL_HISTORY_FILE`` and a fresh Context here
+     reads them back through ``SELECT ... FROM system.queries``;
+  6. ``GET /v1/engine`` reports a live query MID-FLIGHT (a sleeping UDF
+     holds one open while the gate polls);
+  7. the estimate feedback loop closes: a repeat run reserves from
+     measured history (``estimate_from_history`` advances).
 
 Exit 0 on success — if the telemetry wiring silently rots (spans not
 opened, counters not routed, endpoint dead), this gate fails loudly.
 """
 import json
 import os
+import subprocess
 import sys
 import tempfile
+import threading
+import time
 import urllib.request
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -34,6 +44,10 @@ os.environ.setdefault("DSQL_TIERED", "0")
 TRACE_DIR = tempfile.mkdtemp(prefix="dsql_obs_")
 os.environ["DSQL_CHROME_TRACE_DIR"] = TRACE_DIR
 os.environ["DSQL_SLOW_QUERY_MS"] = "0"   # every query trips the slow log
+# flight recorder armed for the whole gate: every query below leaves a
+# persistent envelope + operator statistics (parts 5-7)
+HIST_FILE = os.path.join(TRACE_DIR, "history.jsonl")
+os.environ["DSQL_HISTORY_FILE"] = HIST_FILE
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -128,6 +142,90 @@ def main() -> int:
     if not blob.get("traceEvents"):
         return fail("chrome trace has no events")
     print(f"ok chrome traces: {len(traces)} files")
+
+    # -- 5. cross-process history via system.queries -------------------------
+    from dask_sql_tpu.runtime import flight_recorder as fr
+    n0 = len(fr.read_events(kind="query"))
+    child_code = (
+        "from dask_sql_tpu import Context\n"
+        "c = Context()\n"
+        "c.create_table('smoke_t', {'a': [1, 2, 3, 4]})\n"
+        "c.sql('SELECT SUM(a) AS s FROM smoke_t')\n"
+        "c.sql('SELECT COUNT(*) AS n FROM smoke_t')\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", child_code],
+                          env=dict(os.environ), capture_output=True,
+                          timeout=600)
+    if proc.returncode != 0:
+        return fail(f"history child process died: {proc.stderr.decode()}")
+    fresh = Context()  # no user tables: reads PURELY through system schema
+    n1 = fresh.sql("SELECT count(*) AS n FROM system.queries"
+                   ).to_pylist()[0][0]
+    if n1 < n0 + 2:
+        return fail(f"system.queries missed the child's queries "
+                    f"({n0} -> {n1})")
+    pids = {r[0] for r in fresh.sql(
+        "SELECT DISTINCT pid FROM system.queries").to_pylist()}
+    if not any(p != os.getpid() for p in pids):
+        return fail("no cross-process pid in system.queries")
+    print(f"ok system.queries: {n1} envelopes incl. child pid")
+
+    # -- 6. /v1/engine mid-flight --------------------------------------------
+    import numpy as np
+    release = threading.Event()
+
+    def slow_fn(x):
+        release.set()
+        time.sleep(1.5)
+        return x.astype(np.float64)
+
+    ctx.create_table("slow_t", {"a": np.arange(8, dtype=np.int64)})
+    ctx.register_function(slow_fn, "slow_fn", [("x", np.int64)], np.float64)
+    srv = ctx.run_server(host="127.0.0.1", port=0, blocking=False)
+    try:
+        base = f"http://127.0.0.1:{srv.server_port}"
+        req = urllib.request.Request(
+            f"{base}/v1/statement",
+            data=b"SELECT SUM(slow_fn(a)) AS s FROM slow_t", method="POST")
+        with urllib.request.urlopen(req) as r:
+            payload = json.loads(r.read())
+        if not release.wait(timeout=120):
+            return fail("mid-flight UDF never started")
+        with urllib.request.urlopen(f"{base}/v1/engine") as r:
+            snap = json.loads(r.read())
+        live = [a for a in snap.get("active", [])
+                if "slow_fn" in a.get("query", "")]
+        if not live:
+            return fail(f"/v1/engine missed the live query: "
+                        f"{snap.get('active')}")
+        for key in ("scheduler", "memory", "cache", "history"):
+            if key not in snap:
+                return fail(f"/v1/engine payload missing {key!r}")
+        deadline = time.time() + 120
+        while "nextUri" in payload and time.time() < deadline:
+            time.sleep(0.05)
+            with urllib.request.urlopen(payload["nextUri"]) as r:
+                payload = json.loads(r.read())
+        if payload.get("data") != [[28.0]]:
+            return fail(f"mid-flight query wrong result: "
+                        f"{payload.get('data')}")
+        print("ok /v1/engine: live query visible mid-flight")
+    finally:
+        srv.shutdown()
+        ctx.server = None
+
+    # -- 7. estimate feedback loop -------------------------------------------
+    before = tel.REGISTRY.get("estimate_from_history")
+    ctx.sql(QUERIES[1], return_futures=False)   # ran in part 1: history hit
+    after = tel.REGISTRY.get("estimate_from_history")
+    if after <= before:
+        return fail("estimate_from_history did not advance on repeat run")
+    ev = fr.read_events(kind="query")[-1]
+    if ev.get("est_source") != "history":
+        return fail(f"repeat run estimated from {ev.get('est_source')!r}, "
+                    "not history")
+    print(f"ok estimate feedback: estimate_from_history={after} "
+          f"est={ev['est_bytes']}B measured={ev['measured_bytes']}B")
 
     print("observability smoke PASSED")
     return 0

@@ -132,6 +132,22 @@ def _tiering_off(request, monkeypatch):
     yield
 
 
+@pytest.fixture(autouse=True)
+def _history_off(request, monkeypatch):
+    """The flight recorder (runtime/flight_recorder.py) is file/env-armed
+    like the quarantine store; an operator's DSQL_HISTORY_FILE must not
+    make unrelated suites append to a real history ring (or perturb
+    zero-overhead-path assumptions).  Off by default, armed explicitly by
+    the dedicated flight-recorder/system-tables/engine suites, and
+    scripts/obs_smoke.py gates the production path."""
+    name = request.module.__name__
+    if ("flight" not in name and "system_tables" not in name
+            and "history" not in name and "engine" not in name):
+        monkeypatch.delenv("DSQL_HISTORY_FILE", raising=False)
+        monkeypatch.delenv("DSQL_HISTORY_MB", raising=False)
+    yield
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bounded_executable_lifetime():
     yield

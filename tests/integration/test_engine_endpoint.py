@@ -1,0 +1,82 @@
+"""GET /v1/engine: the one-poll live engine snapshot (server/app.py
+_engine_snapshot) — payload shape, counters, and a mid-flight query."""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def server(tmp_path, monkeypatch):
+    monkeypatch.setenv("DSQL_HISTORY_FILE", str(tmp_path / "hist.jsonl"))
+    from dask_sql_tpu.context import Context
+    from dask_sql_tpu.server.app import run_server
+
+    context = Context()
+    context.create_table("t", {"a": np.arange(8, dtype=np.int64)})
+    release = threading.Event()
+
+    def slow_fn(x):
+        release.set()
+        time.sleep(1.5)
+        return x.astype(np.float64)
+
+    context.register_function(slow_fn, "slow_fn", [("x", np.int64)],
+                              np.float64)
+    srv = run_server(context=context, host="127.0.0.1", port=0,
+                     blocking=False)
+    yield f"http://127.0.0.1:{srv.server_port}", release
+    srv.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read())
+
+
+def _post(url, body):
+    req = urllib.request.Request(url, data=body.encode(), method="POST")
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_engine_snapshot_shape(server):
+    base, _ = server
+    snap = _get(f"{base}/v1/engine")
+    for key in ("pid", "active", "serverQueries", "scheduler", "memory",
+                "cache", "quarantine", "programStore",
+                "backgroundCompiles", "history"):
+        assert key in snap, key
+    assert snap["history"]["enabled"] is True
+    assert snap["history"]["file"].endswith("hist.jsonl")
+    sched = snap["scheduler"]
+    assert {"enabled", "limit", "queueDepth", "running", "waiting",
+            "draining"} <= set(sched)
+    assert {"budgetBytes", "reservedBytes"} <= set(snap["memory"])
+    assert {"entries", "device_bytes", "host_bytes"} <= set(snap["cache"])
+
+
+def test_engine_reports_query_mid_flight(server):
+    base, release = server
+    payload = _post(f"{base}/v1/statement",
+                    "SELECT SUM(slow_fn(a)) AS s FROM t")
+    assert release.wait(timeout=60), "UDF never started"
+    snap = _get(f"{base}/v1/engine")
+    live = [a for a in snap["active"] if "slow_fn" in a["query"]]
+    assert live, f"mid-flight query missing from snapshot: {snap['active']}"
+    assert live[0]["elapsedMillis"] >= 0
+    assert any(q["state"] in ("RUNNING", "QUEUED")
+               for q in snap["serverQueries"])
+    # drain the query so the server fixture can shut down cleanly
+    deadline = time.time() + 60
+    while "nextUri" in payload and time.time() < deadline:
+        time.sleep(0.05)
+        payload = _get(payload["nextUri"])
+    assert payload["data"] == [[28.0]]
+    snap = _get(f"{base}/v1/engine")
+    assert not any("slow_fn" in a["query"] for a in snap["active"])
+    # the finished query is in the persistent history now
+    assert snap["history"]["records"] >= 1

@@ -59,6 +59,22 @@ def test_explain_analyze_row_counts_are_real(ctx):
     assert "rows_out=3" in trailer
 
 
+def test_explain_analyze_tier_line(ctx):
+    """The ``-- tier:`` trailer mirrors ``-- cache:``: the execution tier
+    a PLAIN run of this plan would answer on (the analyzed run itself is
+    always eager, per-node instrumentation being the point)."""
+    out = ctx.sql("EXPLAIN ANALYZE " + JOIN_GROUPBY, return_futures=False)
+    lines = list(out["PLAN"])
+    tier_line = next(l for l in lines if l.startswith("-- tier:"))
+    tier = tier_line.split()[2]
+    assert tier in ("eager", "compiled", "eager-compiling", "compiled-cold")
+    # tests pin tiering off and DSQL_COMPILE stays on: a cold plan would
+    # pay the compile on arrival
+    if os.environ.get("DSQL_COMPILE") != "0":
+        assert tier in ("compiled", "compiled-cold")
+    assert any(l.startswith("-- cache:") for l in lines)  # both trailers
+
+
 def test_plain_explain_unchanged(ctx):
     out = ctx.sql("EXPLAIN " + JOIN_GROUPBY, return_futures=False)
     lines = list(out["PLAN"])

@@ -1,0 +1,238 @@
+"""Flight recorder failure modes (runtime/flight_recorder.py): ring
+truncation, corrupt-line tolerance, cross-process appends, the EWMA
+statistics history, and the zero-overhead disabled path."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu.runtime import flight_recorder as fr
+from dask_sql_tpu.runtime import telemetry as tel
+
+
+@pytest.fixture()
+def hist(tmp_path, monkeypatch):
+    path = str(tmp_path / "hist.jsonl")
+    monkeypatch.setenv("DSQL_HISTORY_FILE", path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# the ring
+# ---------------------------------------------------------------------------
+
+def test_append_and_read_roundtrip(hist):
+    fr._append(hist, {"kind": "query", "i": 1})
+    fr._append(hist, {"kind": "stage", "i": 2})
+    fr._append(hist, {"kind": "query", "i": 3})
+    assert [e["i"] for e in fr.read_events()] == [1, 2, 3]
+    assert [e["i"] for e in fr.read_events(kind="query")] == [1, 3]
+    assert [e["i"] for e in fr.read_events(kind="query", limit=1)] == [3]
+
+
+def test_ring_truncates_at_limit(hist, monkeypatch):
+    # fractional DSQL_HISTORY_MB; the floor clamps to 4096 bytes
+    monkeypatch.setenv("DSQL_HISTORY_MB", "0.001")
+    assert fr.history_limit_bytes() == 4096
+    before = tel.REGISTRY.get("history_truncations")
+    pad = "x" * 100
+    for i in range(100):
+        fr._append(hist, {"kind": "query", "i": i, "pad": pad})
+    assert os.path.getsize(hist) <= 4096
+    assert tel.REGISTRY.get("history_truncations") > before
+    events = fr.read_events()
+    assert events, "ring kept SOME history"
+    # the ring keeps the NEWEST records and drops the oldest
+    assert events[-1]["i"] == 99
+    assert events[0]["i"] > 0
+    assert [e["i"] for e in events] == sorted(e["i"] for e in events)
+
+
+def test_history_limit_parsing(monkeypatch):
+    monkeypatch.delenv("DSQL_HISTORY_MB", raising=False)
+    assert fr.history_limit_bytes() == 16 * 2**20
+    monkeypatch.setenv("DSQL_HISTORY_MB", "2")
+    assert fr.history_limit_bytes() == 2 * 2**20
+    monkeypatch.setenv("DSQL_HISTORY_MB", "not-a-number")
+    assert fr.history_limit_bytes() == 16 * 2**20
+
+
+def test_corrupt_lines_are_skipped(hist):
+    fr._append(hist, {"kind": "query", "i": 1})
+    with open(hist, "ab") as f:
+        f.write(b"this is not json\n")
+        f.write(b'{"kind": "query", "torn": tru')  # torn mid-write
+        f.write(b"\n[1, 2, 3]\n")                  # json but not a dict
+    fr._append(hist, {"kind": "query", "i": 2})
+    assert [e["i"] for e in fr.read_events()] == [1, 2]
+
+
+def test_missing_file_reads_empty(hist):
+    assert fr.read_events() == []
+
+
+def test_disabled_reads_empty(monkeypatch):
+    monkeypatch.delenv("DSQL_HISTORY_FILE", raising=False)
+    assert fr.read_events() == []
+    assert fr.history_path() is None
+    assert not fr.enabled()
+
+
+def test_concurrent_appends_from_two_processes(hist, monkeypatch):
+    monkeypatch.setenv("DSQL_HISTORY_MB", "10")
+    code = (
+        "import os\n"
+        "from dask_sql_tpu.runtime import flight_recorder as fr\n"
+        "p = os.environ['DSQL_HISTORY_FILE']\n"
+        "tag = os.environ['FR_TAG']\n"
+        "for i in range(150):\n"
+        "    fr._append(p, {'kind': 'query', 'tag': tag, 'i': i})\n"
+    )
+    procs = []
+    for tag in ("a", "b"):
+        env = dict(os.environ, FR_TAG=tag, JAX_PLATFORMS="cpu")
+        procs.append(subprocess.Popen([sys.executable, "-c", code], env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE))
+    for p in procs:
+        _, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err.decode()
+    # every line parses (O_APPEND single-write atomicity: interleaved
+    # writers can never tear each other's lines)
+    with open(hist, "rb") as f:
+        lines = f.readlines()
+    events = [json.loads(raw) for raw in lines]
+    assert len(events) == 300
+    for tag in ("a", "b"):
+        seen = [e["i"] for e in events if e["tag"] == tag]
+        assert seen == list(range(150))  # per-writer order preserved
+
+
+# ---------------------------------------------------------------------------
+# EWMA statistics history
+# ---------------------------------------------------------------------------
+
+def test_ewma_stats_fold(hist):
+    fr._observe_stat("fp1", nbytes=1000, rows=10, ms=5.0)
+    e = fr.get_stats("fp1")
+    assert e["bytes"] == 1000.0 and e["rows"] == 10.0 and e["n"] == 1
+    fr._observe_stat("fp1", nbytes=2000)
+    e = fr.get_stats("fp1")
+    assert e["bytes"] == pytest.approx(0.3 * 2000 + 0.7 * 1000)
+    assert e["rows"] == 10.0  # untouched fields keep their EWMA
+    assert e["n"] == 2
+    assert fr.get_stats("missing") is None
+
+
+def test_plan_history_bytes_headroom(hist, monkeypatch):
+    c = Context()
+    c.create_table("t", {"a": [1, 2, 3]})
+    from dask_sql_tpu.sql.parser import parse_sql
+    plan = c._get_plan(parse_sql("SELECT SUM(a) AS s FROM t")[0].query)
+    fp = fr.plan_fingerprint(plan, c)
+    assert fp is not None
+    assert fr.plan_history_bytes(plan, c) is None  # never measured
+    fr._observe_stat(fp, nbytes=1000)
+    assert fr.plan_history_bytes(plan, c) == 1500  # default 1.5x headroom
+    monkeypatch.setenv("DSQL_HISTORY_HEADROOM", "2.0")
+    assert fr.plan_history_bytes(plan, c) == 2000
+    monkeypatch.setenv("DSQL_HISTORY_HEADROOM", "0.5")
+    assert fr.plan_history_bytes(plan, c) == 1000  # clamped to >= 1.0
+
+
+# ---------------------------------------------------------------------------
+# recording through real queries
+# ---------------------------------------------------------------------------
+
+def test_query_envelope_recorded(hist):
+    c = Context()
+    c.create_table("t", {"a": [1, 2, 3], "b": [1.0, 2.0, 3.0]})
+    c.sql("SELECT a, SUM(b) AS s FROM t GROUP BY a")
+    events = fr.read_events(kind="query")
+    assert len(events) == 1
+    e = events[0]
+    assert e["outcome"] == "ok" and e["error"] == ""
+    assert e["query"].startswith("SELECT a, SUM(b)")
+    assert e["pid"] == os.getpid()
+    assert e["rows_out"] == 3
+    assert e["plan_fp"]
+    assert e["wall_ms"] > 0
+    # the plan-level EWMA entry fed from the envelope
+    assert fr.get_stats(e["plan_fp"])["n"] == 1
+
+
+def test_error_envelope_recorded(hist):
+    c = Context()
+    c.create_table("t", {"a": [1, 2, 3]})
+    with pytest.raises(Exception):
+        c.sql("SELECT nosuchcolumn FROM t")
+    events = fr.read_events(kind="query")
+    assert len(events) == 1
+    assert events[0]["outcome"] == "error"
+    assert events[0]["error"] != ""
+
+
+def test_cross_process_history_via_system_queries(hist):
+    """A FRESH interpreter's queries land in the ring; this process then
+    reads them through SQL (the acceptance-criteria proof)."""
+    code = (
+        "from dask_sql_tpu import Context\n"
+        "c = Context()\n"
+        "c.create_table('t', {'a': [1, 2, 3]})\n"
+        "c.sql('SELECT SUM(a) AS s FROM t')\n"
+        "c.sql('SELECT COUNT(*) AS n FROM t')\n"
+        "c.sql('SELECT MAX(a) AS m FROM t')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DSQL_TIERED="0",
+               DSQL_MAX_CONCURRENT_QUERIES="0", DSQL_RESULT_CACHE_MB="0")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr.decode()
+
+    child_events = fr.read_events(kind="query")
+    assert len(child_events) == 3
+    assert all(e["pid"] != os.getpid() for e in child_events)
+
+    c = Context()  # fresh context, no tables — only the system schema
+    rows = c.sql("SELECT count(*) AS n FROM system.queries").to_pylist()
+    assert rows[0][0] >= 3
+    pids = c.sql("SELECT DISTINCT pid FROM system.queries").to_pylist()
+    assert any(p[0] != os.getpid() for p in pids)
+
+
+# ---------------------------------------------------------------------------
+# the zero-overhead disabled path
+# ---------------------------------------------------------------------------
+
+class _Tripwire:
+    """A context manager / callable that fails the test when touched."""
+
+    def __enter__(self):
+        raise AssertionError("disabled path touched the recorder lock")
+
+    def __exit__(self, *a):
+        return False
+
+    def __call__(self, *a, **k):
+        raise AssertionError("disabled path called into the recorder")
+
+
+def test_disabled_path_touches_nothing(monkeypatch):
+    """With DSQL_HISTORY_FILE unset the hot path must not take the
+    recorder's lock, append, observe stats, or register live traces —
+    every hook is a single env lookup returning early."""
+    monkeypatch.delenv("DSQL_HISTORY_FILE", raising=False)
+    monkeypatch.setattr(fr, "_LOCK", _Tripwire())
+    monkeypatch.setattr(fr, "_append", _Tripwire())
+    monkeypatch.setattr(fr, "_observe_stat", _Tripwire())
+    monkeypatch.setattr(fr, "begin_query", _Tripwire())
+    before = tel.REGISTRY.get("history_records")
+    c = Context()
+    c.create_table("t", {"a": [1, 2, 3]})
+    out = c.sql("SELECT SUM(a) AS s FROM t")
+    assert out.to_pylist() == [[6]]
+    assert fr._ACTIVE == {}
+    assert tel.REGISTRY.get("history_records") == before

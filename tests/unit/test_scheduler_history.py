@@ -1,0 +1,69 @@
+"""The estimate feedback loop (scheduler.estimate_working_set +
+flight_recorder.plan_history_bytes): first run is the scan-bytes
+heuristic, repeat runs reserve from measured history."""
+import numpy as np
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu.runtime import flight_recorder as fr
+from dask_sql_tpu.runtime import scheduler as sched
+from dask_sql_tpu.runtime import telemetry as tel
+from dask_sql_tpu.sql.parser import parse_sql
+
+
+@pytest.fixture()
+def hist(tmp_path, monkeypatch):
+    # module name carries "scheduler", so the conftest pin leaves the
+    # workload manager ON; arm a small concurrency limit explicitly
+    monkeypatch.setenv("DSQL_MAX_CONCURRENT_QUERIES", "2")
+    path = str(tmp_path / "hist.jsonl")
+    monkeypatch.setenv("DSQL_HISTORY_FILE", path)
+    return path
+
+
+def test_estimate_from_history_on_repeat_run(hist):
+    c = Context()
+    c.create_table("t", {"a": np.arange(64, dtype=np.int64),
+                         "b": np.arange(64, dtype=np.float64)})
+    sql = "SELECT a, SUM(b) AS s FROM t GROUP BY a"
+
+    before = tel.REGISTRY.get("estimate_from_history")
+    c.sql(sql)
+    # first run had no history: the heuristic answered
+    assert tel.REGISTRY.get("estimate_from_history") == before
+    ev1 = fr.read_events(kind="query")[-1]
+    assert ev1["est_source"] == "heuristic"
+    assert ev1["measured_bytes"] > 0
+
+    c.sql(sql)
+    assert tel.REGISTRY.get("estimate_from_history") == before + 1
+    ev2 = fr.read_events(kind="query")[-1]
+    assert ev2["est_source"] == "history"
+    # the measured reservation is far tighter than the scan-bytes guess
+    assert ev2["est_bytes"] < ev1["est_bytes"]
+    assert ev2["est_bytes"] >= ev2["measured_bytes"]  # headroom holds
+
+
+def test_estimate_working_set_sources(hist):
+    c = Context()
+    c.create_table("t", {"a": np.arange(32, dtype=np.int64)})
+    plan = c._get_plan(parse_sql("SELECT SUM(a) AS s FROM t")[0].query)
+
+    est, src = sched.estimate_working_set(plan, c)
+    assert src == "heuristic"
+    assert est == sched.estimate_plan_bytes(plan, c)
+
+    fp = fr.plan_fingerprint(plan, c)
+    fr._observe_stat(fp, nbytes=10 * 2**20)
+    est2, src2 = sched.estimate_working_set(plan, c)
+    assert src2 == "history"
+    assert est2 == 15 * 2**20  # 10 MiB EWMA x 1.5 headroom
+
+
+def test_heuristic_when_recorder_disabled(monkeypatch):
+    monkeypatch.delenv("DSQL_HISTORY_FILE", raising=False)
+    c = Context()
+    c.create_table("t", {"a": np.arange(32, dtype=np.int64)})
+    plan = c._get_plan(parse_sql("SELECT SUM(a) AS s FROM t")[0].query)
+    _est, src = sched.estimate_working_set(plan, c)
+    assert src == "heuristic"

@@ -1,0 +1,133 @@
+"""The read-only ``system`` schema (runtime/system_tables.py +
+Context._resolve_system_table): lazy resolution, fixed schemas at zero
+rows, the LIVE system.active view, result-cache exemption, and
+user-schema shadowing."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu.runtime import flight_recorder as fr
+from dask_sql_tpu.runtime import telemetry as tel
+
+ALL_TABLES = ("queries", "active", "metrics", "cache", "quarantine",
+              "programs")
+
+
+@pytest.fixture()
+def hist(tmp_path, monkeypatch):
+    path = str(tmp_path / "hist.jsonl")
+    monkeypatch.setenv("DSQL_HISTORY_FILE", path)
+    return path
+
+
+def test_all_tables_bind_and_execute_when_empty(hist):
+    c = Context()  # no user tables at all
+    for t in ALL_TABLES:
+        out = c.sql(f"SELECT * FROM system.{t}")
+        assert out.num_columns > 0, t
+
+
+def test_all_tables_bind_without_recorder(monkeypatch):
+    monkeypatch.delenv("DSQL_HISTORY_FILE", raising=False)
+    c = Context()
+    for t in ALL_TABLES:
+        out = c.sql(f"SELECT * FROM system.{t}")
+        assert out.num_columns > 0, t
+    # no history file: the queries view is simply empty
+    assert c.sql("SELECT count(*) AS n FROM system.queries"
+                 ).to_pylist() == [[0]]
+
+
+def test_queries_reflects_executed_queries(hist):
+    c = Context()
+    c.create_table("t", {"a": [1, 2, 3]})
+    c.sql("SELECT SUM(a) AS s FROM t")
+    rows = c.sql("SELECT query, outcome, rows_out FROM system.queries"
+                 ).to_pylist()
+    assert ["SELECT SUM(a) AS s FROM t", "ok", 1] in rows
+
+
+def test_metrics_table_carries_registry(hist):
+    c = Context()
+    rows = c.sql("SELECT name, kind, value FROM system.metrics").to_pylist()
+    names = {r[0] for r in rows}
+    assert "queries" in names and "history_records" in names
+    assert {r[1] for r in rows} <= {"counter", "gauge"}
+
+
+def test_system_reads_are_never_cached(hist, monkeypatch):
+    monkeypatch.setenv("DSQL_RESULT_CACHE_MB", "64")
+    c = Context()
+    before_h = tel.REGISTRY.get("result_cache_hits")
+    n1 = c.sql("SELECT count(*) AS n FROM system.queries").to_pylist()[0][0]
+    n2 = c.sql("SELECT count(*) AS n FROM system.queries").to_pylist()[0][0]
+    # the first count(*) recorded its own envelope, so an UNCACHED second
+    # read must see one more row; a (stale) cache hit would repeat n1
+    assert n2 == n1 + 1
+    assert tel.REGISTRY.get("result_cache_hits") == before_h
+
+
+def test_plan_key_is_volatile_for_system_scans(hist):
+    from dask_sql_tpu.runtime import result_cache as _rc
+    from dask_sql_tpu.sql.parser import parse_sql
+
+    c = Context()
+    plan = c._get_plan(parse_sql("SELECT * FROM system.metrics")[0].query)
+    text, volatile, _scans = _rc.canonical_plan(plan, c)
+    assert volatile
+
+
+def test_user_schema_named_system_shadows_builtin(hist):
+    c = Context()
+    c.sql("CREATE SCHEMA system")
+    with pytest.raises(Exception):
+        c.sql("SELECT * FROM system.queries")
+    c.sql("DROP SCHEMA system")
+    assert c.sql("SELECT * FROM system.metrics").num_rows > 0
+
+
+def test_active_reflects_live_query(hist):
+    """system.active must show a query WHILE it runs (live view, not a
+    snapshot fixture): a sleeping vectorized UDF holds one query open in a
+    worker thread while the main thread polls through SQL."""
+    c = Context()
+    c.create_table("t", {"a": np.arange(8, dtype=np.int64)})
+    release = threading.Event()
+
+    def slow_fn(x):
+        release.set()
+        time.sleep(1.5)
+        return x.astype(np.float64)
+
+    c.register_function(slow_fn, "slow_fn", [("x", np.int64)], np.float64)
+    result = {}
+
+    def run():
+        result["table"] = c.sql(
+            "SELECT SUM(slow_fn(a)) AS s FROM t").to_pylist()
+
+    worker = threading.Thread(target=run)
+    worker.start()
+    try:
+        assert release.wait(timeout=60), "UDF never started"
+        rows = c.sql("SELECT state, query, phase FROM system.active"
+                     ).to_pylist()
+        running = [r for r in rows if "slow_fn" in r[1]]
+        assert running, f"live query not visible in system.active: {rows}"
+        assert running[0][0] == "running"
+    finally:
+        worker.join(timeout=60)
+    assert result["table"] == [[28.0]]
+    # after completion the live registry is drained again
+    rows = c.sql("SELECT query FROM system.active").to_pylist()
+    assert not any("slow_fn" in r[0] for r in rows)
+    assert len(fr._ACTIVE) <= 1  # only the poll itself may still be open
+
+
+def test_unknown_system_table_errors(hist):
+    c = Context()
+    with pytest.raises(Exception):
+        c.sql("SELECT * FROM system.nosuchtable")

@@ -290,6 +290,25 @@ def test_slow_query_log_counter(monkeypatch, caplog):
     assert any("slow query" in r.message for r in caplog.records)
 
 
+def test_slow_query_log_is_self_contained(monkeypatch, caplog):
+    """A slow-log line carries tier, cacheHit and priority so triage
+    needs no query replay."""
+    import logging
+    monkeypatch.setenv("DSQL_SLOW_QUERY_MS", "0")
+    with caplog.at_level(logging.WARNING,
+                         logger="dask_sql_tpu.runtime.telemetry"):
+        with tel.trace_scope("SELECT triage"):
+            with tel.span("queued", priority="batch"):
+                pass
+            with tel.span("execute", tier="compiled"):
+                pass
+    msg = next(r.message for r in caplog.records
+               if "SELECT triage" in r.message)
+    assert "tier: compiled" in msg
+    assert "cacheHit: False" in msg
+    assert "priority: batch" in msg
+
+
 def test_last_report_is_thread_local():
     with tel.trace_scope("mine"):
         pass
