@@ -443,9 +443,18 @@ def _stage_main():
                 # — every number is already journaled
                 emit({"requiesce_fail": qid, "error": repr(e)[:200]})
                 continue
+            # per-query adaptive operator choices (runtime/statistics.py):
+            # the report collects record_choice lines from the span tree,
+            # so the journal names the variant every published time ran on
+            try:
+                from dask_sql_tpu.runtime import telemetry as _tl
+                rep = _tl.last_report()
+                ops = list(getattr(rep, "operators", ()) or ())
+            except Exception:
+                ops = []
             emit({"q": qid, "sec": round(best, 4),
                   "platform": real_platform, "quiesced": True,
-                  "breakdown": bd})
+                  "breakdown": bd, "operators": ops})
 
         # WARM-REPEAT pass: result cache armed, each measured query run
         # twice — run 1 populates, run 2 must be a full-query hit.  The
@@ -591,9 +600,22 @@ def _stage_main():
             mem.setdefault("table_bytes_resident", tbl_bytes)
         except Exception:
             pass
+        # adaptive-dispatch counters (operator_choice_* + the stats
+        # cap-hint/scheduler-source evidence) ride the stage_done record
+        opc = {}
+        try:
+            from dask_sql_tpu.runtime import telemetry as _tl
+            for k, v in _tl.REGISTRY.counters().items():
+                if (k.startswith("operator_choice_")
+                        or k in ("stats_cap_hints", "estimate_from_stats",
+                                 "stats_tables_collected")):
+                    opc[k] = int(v)
+        except Exception:
+            pass
         emit({"stage_done": True, "load_sec": round(load_sec, 1),
               "warmup_sec": round(warmup_sec, 1), "device_memory": mem,
-              "compiled_stats": dict(compiled.stats)})
+              "compiled_stats": dict(compiled.stats),
+              "operator_counters": opc})
         sys.stdout.flush()
         sys.stderr.flush()
     os._exit(0)  # don't join wedged warmup threads
@@ -666,6 +688,7 @@ def main():
         started, warm_fails, breakdowns, quiesced = set(), {}, {}, set()
         warm_hits = {}
         bursts = []
+        query_ops, op_counters = {}, {}
         first_arrival, restart_times, restart_info = {}, {}, {}
         est_err, est_err_admitted, est_from_hist = {}, {}, None
         load_sec = warmup_sec = 0.0
@@ -680,6 +703,9 @@ def main():
                         prev = times.get(rec["q"])
                         if prev is None or rec["sec"] < prev:
                             times[rec["q"]] = rec["sec"]
+                            if rec.get("operators"):
+                                # variant attribution follows the best rec
+                                query_ops[rec["q"]] = rec["operators"]
                         if rec.get("breakdown"):
                             # breakdowns keep their own minimum over the
                             # records that carry one: a faster record
@@ -729,6 +755,9 @@ def main():
                             mem[k] = max(mem.get(k, 0), v)
                         for k, v in (rec.get("compiled_stats") or {}).items():
                             cstats[k] = cstats.get(k, 0) + v
+                        for k, v in (rec.get("operator_counters")
+                                     or {}).items():
+                            op_counters[k] = op_counters.get(k, 0) + v
         except Exception:
             pass
         done = sorted(times)
@@ -805,6 +834,16 @@ def main():
                     "pandas_sec": {str(k): round(p_times[k], 4)
                                    for k in sorted(p_times)},
                     "pandas_geomean_sec": round(geo_p, 4),
+                    # the PR-10 success metric spelled out: geomean of
+                    # per-query pandas/engine speedups (same number as
+                    # vs_baseline; >1.0 = the engine beats pandas warm)
+                    "vs_pandas_geomean": round(ratio, 3),
+                    # adaptive-dispatch evidence (runtime/statistics.py):
+                    # which variant each published time ran on, and the
+                    # operator_choice_* counter totals across the run
+                    "query_operators": {str(k): query_ops[k]
+                                        for k in sorted(query_ops)},
+                    "operator_choice": op_counters or None,
                     "warm_or_compile_sec_per_query":
                         {str(k): warm_times[k] for k in sorted(warm_times)},
                     # tiered-execution / program-store evidence: latency of

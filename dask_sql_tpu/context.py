@@ -161,9 +161,16 @@ class Context:
         if self.mesh is not None:
             from .parallel.mesh import shard_table_with_validity
             table, row_valid = shard_table_with_validity(table, self.mesh)
+        # ingest-time statistics (runtime/statistics.py): NDV/min-max/null
+        # fraction/dense-int detection per column — the base layer of the
+        # adaptive-dispatch vertical.  Best-effort: a failed collection
+        # leaves entry.stats None and every consumer falls back to the
+        # pre-stats behavior.
+        from .runtime.statistics import collect_table_stats
+        stats = collect_table_stats(table, row_valid=row_valid)
         entry = TableEntry(table=table, statistics=statistics,
                            filepath=input_table if isinstance(input_table, str) else None,
-                           gpu=gpu, row_valid=row_valid)
+                           gpu=gpu, row_valid=row_valid, stats=stats)
         self.schema[schema_name].tables[table_name.lower()] = entry
         self.bump_table_epoch(schema_name, table_name)
         logger.debug("Registered table %s.%s (%d rows)", schema_name,
@@ -422,7 +429,10 @@ class Context:
     def _get_plan(self, query: A.SelectLike, sql: str = "") -> RelNode:
         binder = Binder(self, sql)
         plan = binder.bind(query)
-        return optimize(plan)
+        # context threads through so the stats-driven join-order pass
+        # (plan/optimizer.py reorder_joins_stats) can rank join orders by
+        # estimated output cardinality
+        return optimize(plan, context=self)
 
     def explain(self, sql: str, dataframes: Optional[dict] = None) -> str:
         """Return the optimized plan as a string (reference context.py:442-468)."""

@@ -30,6 +30,12 @@ class TableEntry:
     table: Optional[Table] = None
     plan: Any = None               # bound RelNode for CREATE VIEW ... AS
     statistics: Optional[dict] = None
+    # ingest-time TableStats (runtime/statistics.py): row count, per-column
+    # NDV/min-max/null-fraction/dense-int detection — drives adaptive
+    # operator dispatch, join ordering, and the scheduler's working-set
+    # estimate.  Separate from ``statistics`` (the user-supplied dict kept
+    # for reference parity).
+    stats: Any = None
     filepath: Optional[str] = None
     gpu: bool = False              # parity flag only
     # mesh mode: columns are padded to device-count divisibility and

@@ -16,14 +16,133 @@ import numpy as np
 
 from ..table import dict_sort_order, Column, Scalar, Table
 from ..types import SqlType, exact_decimal_scale, physical_dtype
-from .kernels import decimal_unscale, factorize_columns
+from .kernels import comparable_data, decimal_unscale, factorize_columns
 
 
-def group_codes(key_cols: List[Column]):
-    """Factorize group keys. Returns (codes, first_row_per_group, G)."""
+def group_codes(key_cols: List[Column], variant: str = "hash",
+                dense_hint=None):
+    """Factorize group keys into dense codes 0..G-1.
+
+    Returns (codes, first_row_per_group, G, used_variant).  ``variant``
+    comes from the stats crossover (runtime/statistics.py): "hash" is the
+    status-quo ``factorize_columns`` (jnp.unique), "sorted" is one stable
+    lexsort + boundary scan, "dense" is the direct-index path
+    (``codes = key - min``, no hashing, no sort) for a single small-domain
+    int key.  All three produce IDENTICAL group numbering (ascending key
+    order, NULL groups first) and identical representative rows, so the
+    dispatch can never change results — a variant that doesn't apply falls
+    through to the next ("dense" → "sorted" needs a single int key;
+    "sorted" and "hash" always apply)."""
     if not key_cols:
-        return None, None, 1
-    return factorize_columns(key_cols, null_as_group=True)
+        return None, None, 1, "none"
+    if variant == "dense":
+        out = _dense_group_codes(key_cols, dense_hint)
+        if out is not None:
+            return (*out, "dense")
+        variant = "sorted"
+    if variant == "sorted":
+        out = _sorted_group_codes(key_cols)
+        if out is not None:
+            return (*out, "sorted")
+    return (*factorize_columns(key_cols, null_as_group=True), "hash")
+
+
+#: hard ceiling on dense direct-index slots even under DSQL_FORCE_GROUPBY
+_DENSE_HARD_CAP = 1 << 22
+
+
+def _dense_group_codes(key_cols: List[Column], dense_hint=None):
+    """Direct-index factorize for ONE integer key: slot = key - lo (+1
+    when NULLs exist, which take slot 0 — matching factorize's NULL-first
+    group order), occupied slots compact to dense codes via a cumsum
+    remap.  O(n + domain), scatter-based — an eager-path variant (the
+    compiled TPU path keeps its scatter-free sorted codes).  Returns None
+    when not applicable (caller falls through)."""
+    if len(key_cols) != 1:
+        return None
+    c = key_cols[0]
+    if c.stype.is_string or not jnp.issubdtype(c.data.dtype, jnp.integer):
+        return None
+    n = len(c)
+    if n == 0:
+        return None
+    data = c.data.astype(jnp.int64)
+    # data under NULL rows is garbage — min/max must see valid rows only
+    if c.mask is not None:
+        if not bool(c.mask.any()):
+            return None
+        imax = jnp.iinfo(jnp.int64).max
+        imin = jnp.iinfo(jnp.int64).min
+        vlo = int(jnp.min(jnp.where(c.mask, data, imax)))
+        vhi = int(jnp.max(jnp.where(c.mask, data, imin)))
+    else:
+        vlo = int(data.min())
+        vhi = int(data.max())
+    if dense_hint is not None:
+        lo, hi = int(dense_hint[0]), int(dense_hint[1])
+        # stale stats guard: rows outside the hinted domain void the hint
+        if vlo < lo or vhi > hi:
+            lo, hi = vlo, vhi
+    else:
+        lo, hi = vlo, vhi
+    domain = hi - lo + 1
+    if domain <= 0 or domain > _DENSE_HARD_CAP:
+        return None
+    has_null = c.mask is not None and bool((~c.mask).any())
+    shift = 1 if has_null else 0
+    slots = jnp.clip(data - lo, 0, domain - 1) + shift
+    if has_null:
+        slots = jnp.where(c.mask, slots, 0)
+    occ = jnp.zeros(domain + shift, dtype=jnp.int64).at[slots].add(1)
+    present = occ > 0
+    # compact: occupied slot k -> dense code rank(k); ascending slot order
+    # IS ascending key order (NULL slot 0 first) — factorize's numbering
+    remap = jnp.cumsum(present.astype(jnp.int64)) - 1
+    num_groups = int(remap[-1]) + 1
+    codes = remap[slots]
+    first = jnp.full(num_groups, n, dtype=jnp.int64).at[codes].min(
+        jnp.arange(n, dtype=jnp.int64))
+    return codes, first, num_groups
+
+
+def _sorted_group_codes(key_cols: List[Column]):
+    """Sort-based factorize: ONE stable lexsort over the key columns, then
+    group boundaries fall out of adjacent-row comparisons — no hash table,
+    no per-column unique.  Profitable when groups are few and fat (the
+    hash/sort crossover).  Group numbering matches factorize exactly:
+    per-column ordering is (null-flag, comparable value) with NULLs first,
+    columns major-to-minor in key order, and the stable sort makes each
+    group's first sorted row its minimum original row index.
+
+    Returns None for floating-point keys (NaN != NaN would split NaN
+    groups where unique's total order would not) — the caller falls back
+    to factorize."""
+    n = len(key_cols[0])
+    if n == 0:
+        return None
+    keys = []  # significance order: col0 flag, col0 value, col1 flag, ...
+    for c in key_cols:
+        data = comparable_data(c)
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            return None
+        if c.mask is not None:
+            keys.append(c.mask.astype(jnp.int8))      # NULL(0) first
+            keys.append(jnp.where(c.mask, data, data[0]))
+        else:
+            keys.append(data)
+    # jnp.lexsort sorts by the LAST key first -> pass minor-to-major
+    order = jnp.lexsort(tuple(reversed(keys)))
+    diff = jnp.zeros(max(n - 1, 0), dtype=bool)
+    for k in keys:
+        ks = k[order]
+        diff = diff | (ks[1:] != ks[:-1])
+    boundary = jnp.concatenate([jnp.ones(1, dtype=bool), diff])
+    codes_sorted = jnp.cumsum(boundary.astype(jnp.int64)) - 1
+    num_groups = int(codes_sorted[-1]) + 1
+    codes = jnp.zeros(n, dtype=jnp.int64).at[order].set(codes_sorted)
+    starts = jnp.nonzero(boundary, size=num_groups)[0]
+    first = order[starts]
+    return codes, first, num_groups
 
 
 def _masked(col: Column, extra_mask: Optional[jax.Array]):

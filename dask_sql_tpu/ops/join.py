@@ -49,20 +49,25 @@ def _expand_matches(lcodes: jax.Array, rcodes: jax.Array
 def join_tables(left: Table, right: Table, left_keys: List[int],
                 right_keys: List[int], join_type: str,
                 null_aware_anti: bool = False,
-                null_equal: bool = False) -> Tuple[Table, Optional[jax.Array]]:
+                null_equal: bool = False,
+                variant: str = "hash") -> Tuple[Table, Optional[jax.Array]]:
     """Equi-join two tables.
 
     Returns (joined_table, matched_pair_row_origin) where the joined table has
     left columns then right columns.  For SEMI/ANTI only left columns.
     Outer-join unmatched rows are appended after the matched pairs with NULLs
     on the other side.
+
+    ``variant="dense"`` (stats-driven) takes the direct-index key coding —
+    ``codes = key - min``, no shared-domain sort — when the key pair is a
+    single integer column; see kernels.join_key_codes.
     """
     nl, nr = left.num_rows, right.num_rows
     if left_keys:
         lcodes, rcodes = join_key_codes(
             [left.columns[i] for i in left_keys],
             [right.columns[i] for i in right_keys],
-            null_equal=null_equal,
+            null_equal=null_equal, variant=variant,
         )
     else:
         # cross join: all pairs

@@ -96,7 +96,8 @@ def factorize_columns(cols: List[Column], *, null_as_group: bool = True
 
 
 def join_key_codes(left: List[Column], right: List[Column],
-                   null_equal: bool = False) -> Tuple[jax.Array, jax.Array]:
+                   null_equal: bool = False, variant: str = "hash"
+                   ) -> Tuple[jax.Array, jax.Array]:
     """Factorize left+right key columns on a shared domain.
 
     Returns int64 codes for each side; -1 marks rows with NULL keys (never
@@ -104,7 +105,17 @@ def join_key_codes(left: List[Column], right: List[Column],
     set-operation equality (SQL "IS NOT DISTINCT FROM"): NULL gets its own
     shared code and matches NULL — INTERSECT/EXCEPT require it (a row
     (NULL, 'x') present on both sides IS in the intersection).
+
+    ``variant="dense"`` (stats-driven, runtime/statistics.py): a single
+    integer key pair skips the shared-domain unique/sort entirely —
+    ``codes = key - min`` is already a valid shared coding (equal keys get
+    equal codes, NULL keeps its sentinel).  Falls back to the factorize
+    path when not applicable, so the flag can never change results.
     """
+    if variant == "dense":
+        out = _dense_join_codes(left, right, null_equal)
+        if out is not None:
+            return out
     nl = len(left[0]) if left else 0
     combined_cols = []
     for lc, rc in zip(left, right):
@@ -144,6 +155,54 @@ def join_key_codes(left: List[Column], right: List[Column],
         bad = bad | (c < 0)
     combined = jnp.where(bad, -1, combined)
     return combined[:nl], combined[nl:]
+
+
+def _dense_join_codes(left: List[Column], right: List[Column],
+                      null_equal: bool):
+    """Direct shared coding for one integer key pair: ``code = key - lo``
+    (``+1`` with NULL as shared code 0 under ``null_equal``).  No unique,
+    no sort — two reductions for ``lo`` are the only synced work.  None
+    when not applicable (multi-column, strings, floats, empty)."""
+    if len(left) != 1 or len(right) != 1:
+        return None
+    lc, rc = left[0], right[0]
+    for c in (lc, rc):
+        if c.stype.is_string or not jnp.issubdtype(c.data.dtype,
+                                                   jnp.integer):
+            return None
+    nl, nr = len(lc), len(rc)
+    if nl + nr == 0:
+        return None
+    imax = jnp.iinfo(jnp.int64).max
+    imin = jnp.iinfo(jnp.int64).min
+    los, his = [], []
+    for c in (lc, rc):
+        if not len(c):
+            continue
+        data = c.data.astype(jnp.int64)
+        if c.mask is not None:
+            los.append(int(jnp.where(c.mask, data, imax).min()))
+            his.append(int(jnp.where(c.mask, data, imin).max()))
+        else:
+            los.append(int(data.min()))
+            his.append(int(data.max()))
+    los = [v for v in los if v != imax]
+    his = [v for v in his if v != imin]
+    if not los or not his:
+        return None  # all keys NULL on both sides
+    lo, hi = min(los), max(his)
+    if hi - lo >= 2 ** 62:
+        # adversarial int64 spread: key - lo could overflow; the
+        # factorize path handles those (rare) layouts
+        return None
+    shift = 1 if null_equal else 0
+    out = []
+    for c in (lc, rc):
+        codes = c.data.astype(jnp.int64) - lo + shift
+        if c.mask is not None:
+            codes = jnp.where(c.mask, codes, 0 if null_equal else -1)
+        out.append(codes)
+    return out[0], out[1]
 
 
 # ---------------------------------------------------------------------------

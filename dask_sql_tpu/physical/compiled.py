@@ -71,7 +71,8 @@ from ..runtime import (faults as _faults, kvstore as _kv,
                        telemetry as _tel)
 from ..table import dict_sort_order, Column, Scalar, Table
 from .rex.evaluate import evaluate_predicate, evaluate_rex
-from .stages import (StageGraph, heavy_count as _heavy_count,
+from .stages import (StageGraph, annotate_stats as _annotate_stage_stats,
+                     heavy_count as _heavy_count,
                      partition as _partition, stage_budget)
 
 logger = logging.getLogger(__name__)
@@ -2469,8 +2470,10 @@ def _make_boundary_scan(node: RelNode, context) -> LogicalTableScan:
 
 
 def _partition_plan(plan: RelNode, budget: int, context) -> StageGraph:
-    return _partition(plan, budget,
-                      lambda sub: _make_boundary_scan(sub, context))
+    graph = _partition(plan, budget,
+                       lambda sub: _make_boundary_scan(sub, context))
+    _annotate_stage_stats(graph, context)
+    return graph
 
 
 def _pad_capacity(table: Table):
@@ -2656,6 +2659,8 @@ def _execute_stage_graph_inner(graph: StageGraph, context, query_fp: str,
         # domain is one stage, not the graph (let alone the query).
         with _res.scoped(rt), _tel.scoped(tel_trace, tel_parent), \
                 _tel.span("stage", index=idx):
+            if stages[idx].est_rows is not None:
+                _tel.annotate(stage_est_rows=stages[idx].est_rows)
             attempt = 0
             while True:
                 _res.check("stage_exec")
@@ -3101,6 +3106,17 @@ def _execute_single(plan: RelNode, context, query_fp: str,
     # "__split__" is the learned budget hint, not an aggregate-site cap: it
     # must not leak into the program cache key or _build's cap lookups
     caps.pop("__split__", None)
+    # stats-derived starting caps for sites the engine has not yet LEARNED
+    # (runtime/statistics.py): setdefault keeps learned/escalated caps
+    # authoritative, and a too-small hint just trips the normal overflow
+    # escalation below — never a wrong result
+    from ..runtime import statistics as _stats
+    hints = _stats.compiled_cap_hints(plan, context)
+    for tag, cap in hints.items():
+        if tag not in caps:
+            caps[tag] = cap
+            _tel.inc("stats_cap_hints")
+            _tel.annotate(cap_hint=f"{tag}={cap}")
     store_tried = False  # one persistent-store attempt per call, tops
     for _ in range(8):  # capacity-escalation bound
         _res.check("execute")

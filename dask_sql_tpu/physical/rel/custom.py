@@ -230,9 +230,13 @@ def _export_model(stmt: A.ExportModel, context, sql):
 def _explain(stmt: A.ExplainStatement, context, sql):
     plan = context._get_plan(stmt.query, sql)
     if not getattr(stmt, "analyze", False):
-        text = plan.explain()
-        return _meta_table({"PLAN": np.array(text.splitlines(),
-                                             dtype=object)})
+        lines = plan.explain().splitlines()
+        # predicted adaptive operator choices (runtime/statistics.py):
+        # what the dispatch WOULD pick for this plan and the stats
+        # driving it — EXPLAIN ANALYZE prints the measured ones instead
+        from ...runtime import statistics as _stats
+        lines.extend(_stats.explain_lines(plan, context))
+        return _meta_table({"PLAN": np.array(lines, dtype=object)})
     return _explain_analyze(plan, context)
 
 
@@ -275,9 +279,11 @@ def _explain_analyze(plan, context):
     except Exception:
         exec_tier = "eager"
 
+    from ...runtime import statistics as _stats
+
     snap0 = _tel.REGISTRY.counters()
     t0 = _time.perf_counter()
-    with _tel.record_nodes() as rec:
+    with _stats.capture() as choices, _tel.record_nodes() as rec:
         if getattr(context, "_has_chunked", False):
             from ..streaming import (execute_streaming,
                                      plan_references_chunked)
@@ -317,6 +323,11 @@ def _explain_analyze(plan, context):
     lines.append(f"-- analyzed: wall={wall_ms:.3f}ms rows_out={rows_out} "
                  f"nodes={len(rec.records)}")
     lines.append(cache_line)
+    # the adaptive operator choices the analyzed run ACTUALLY took
+    # (vs the predictions plain EXPLAIN prints)
+    for op, variant, info in choices:
+        lines.append("-- operator: " + _stats.format_choice(op, variant,
+                                                            info))
     store_hits = (snap1.get("program_store_hits", 0)
                   - snap0.get("program_store_hits", 0))
     tier_line = f"-- tier: {exec_tier}"

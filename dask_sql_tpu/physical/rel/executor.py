@@ -118,12 +118,23 @@ def _values(rel: LogicalValues, ex: RelExecutor) -> Table:
 
 
 def _aggregate(rel: LogicalAggregate, ex: RelExecutor) -> Table:
+    from ...runtime import statistics as _stats
+
     src = ex.execute(rel.input)
     n = src.num_rows
     key_cols = [src.columns[i] for i in rel.group_keys]
 
     if rel.group_keys:
-        codes, first, num_groups = G.group_codes(key_cols)
+        # stats-driven dispatch (runtime/statistics.py): the hash/sort
+        # crossover plus the dense direct-index path; DSQL_ADAPTIVE=0 and
+        # unknown stats both yield "hash" — the pre-stats factorize.
+        variant, info = _stats.groupby_decision(rel, ex.context)
+        hint = (info["lo"], info["hi"]) if "lo" in info else None
+        codes, first, num_groups, used = G.group_codes(
+            key_cols, variant=variant, dense_hint=hint)
+        if used != "hash" or info:
+            _stats.record_choice("groupby", used, **{
+                k: v for k, v in info.items() if k not in ("lo", "hi")})
     else:
         codes, first, num_groups = None, None, 1
 
@@ -201,11 +212,23 @@ from ...plan.optimizer import split_join_condition as _extract_equi_keys  # noqa
 
 
 def _join(rel: LogicalJoin, ex: RelExecutor) -> Table:
+    from ...runtime import statistics as _stats
+
     left = ex.execute(rel.left)
     right = ex.execute(rel.right)
     nl = len(left.names)
     equi, residual = _extract_equi_keys(rel)
     jt = rel.join_type
+
+    def _key_variant(lk, rk) -> str:
+        # stats-driven dense direct-index coding (codes = key - min) for a
+        # single int key pair; "hash" = the pre-stats shared factorize
+        variant, info = _stats.join_decision(
+            rel, [left.columns[i] for i in lk],
+            [right.columns[i] for i in rk], ex.context)
+        if variant != "hash" or info:
+            _stats.record_choice("join", variant, **info)
+        return variant
 
     # disambiguate duplicate column names across sides (schema names win)
     out_names = [f.name for f in rel.schema]
@@ -229,10 +252,12 @@ def _join(rel: LogicalJoin, ex: RelExecutor) -> Table:
             assert not null_aware
             from ...ops.kernels import join_key_codes
             lcodes, rcodes = join_key_codes([left.columns[i] for i in lk],
-                                            [right.columns[i] for i in rk])
+                                            [right.columns[i] for i in rk],
+                                            variant=_key_variant(lk, rk))
             li, ri, _counts = J._expand_matches(lcodes, rcodes)
             return _semi_anti_pairs(ex, left, right, li, ri, residual, jt)
-        out, _ = J.join_tables(left, right, lk, rk, jt, null_aware)
+        out, _ = J.join_tables(left, right, lk, rk, jt, null_aware,
+                               variant=_key_variant(lk, rk))
         return out
 
     if not equi:
@@ -254,13 +279,15 @@ def _join(rel: LogicalJoin, ex: RelExecutor) -> Table:
     rk = [k for _, k in equi]
 
     if not residual:
-        out, _ = J.join_tables(left, right, lk, rk, jt)
+        out, _ = J.join_tables(left, right, lk, rk, jt,
+                               variant=_key_variant(lk, rk))
         return out.with_names(out_names)
 
     # equi + residual: build inner pairs, filter, then outer recovery
     from ...ops.kernels import join_key_codes
     lcodes, rcodes = join_key_codes([left.columns[i] for i in lk],
-                                    [right.columns[i] for i in rk])
+                                    [right.columns[i] for i in rk],
+                                    variant=_key_variant(lk, rk))
     li, ri, counts = J._expand_matches(lcodes, rcodes)
     lt, rt = left.take(li), right.take(ri)
     pairs = Table(out_names, lt.columns + rt.columns)

@@ -98,6 +98,8 @@ class Stage:
     deps: Tuple[int, ...]
     heavy: int
     scan: Optional[RelNode] = None
+    #: statistics-estimated output rows (annotate_stats; None = unknown)
+    est_rows: Optional[int] = None
 
 
 @dataclass
@@ -174,3 +176,24 @@ def partition(plan: RelNode, budget: int,
     stages.append(Stage(plan=root_plan, deps=stage_deps(root_plan),
                         heavy=root_heavy, scan=None))
     return StageGraph(stages)
+
+
+def annotate_stats(graph: StageGraph, context) -> None:
+    """Attach statistics-estimated output rows to every stage
+    (runtime/statistics.py — filter selectivity from ingest min/max plus
+    join/aggregate cardinality rules).  The estimate rides along to the
+    stage spans and the flight recorder so padded-capacity waste
+    (``stage_capacity`` vs ``stage_est_rows``) is visible before the
+    first run ever measures it; unknown stays None and costs nothing.
+    No-op when adaptive selection is off (DSQL_ADAPTIVE=0)."""
+    from ..runtime import statistics as _stats
+
+    if context is None or not _stats.adaptive_enabled():
+        return
+    for st in graph.stages:
+        try:
+            est = _stats.estimate_rows(st.plan, context)
+        except Exception:
+            est = None
+        if est is not None:
+            st.est_rows = int(est)

@@ -291,6 +291,7 @@ def record_query(report, error: Optional[BaseException] = None) -> None:
         "est_source": est_source or "",
         "queued_ms": float(queued_ms or 0.0),
         "plan_fp": plan_fp or "",
+        "operators": list(getattr(report, "operators", ()) or ()),
         "phases": {k: round(v, 3) for k, v in report.phases.items()},
     }
     _append(path, rec)

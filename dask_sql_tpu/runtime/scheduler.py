@@ -202,13 +202,23 @@ def estimate_working_set(plan, context) -> "Tuple[int, str]":
     from bytes the engine actually touched on previous runs of the same
     shape (× DSQL_HISTORY_HEADROOM) instead of the scan-bytes×multiplier
     guess — counter ``estimate_from_history`` tallies those.  Never-seen
-    plans (and a disabled recorder) keep the heuristic."""
+    plans (and a disabled recorder) keep the heuristic.
+
+    Between those two sits the TableStats path (runtime/statistics.py):
+    never-seen plans whose heavy operators are all estimable from ingest
+    stats reserve estimated-cardinality bytes instead of the blunt
+    scan-bytes×multiplier guess — counter ``estimate_from_stats``."""
     from . import flight_recorder as _fr
+    from . import statistics as _stats
 
     hist = _fr.plan_history_bytes(plan, context)
     if hist is not None:
         _tel.inc("estimate_from_history")
         return max(int(hist), _MIN_ESTIMATE), "history"
+    est = _stats.estimate_plan_bytes_stats(plan, context)
+    if est is not None:
+        _tel.inc("estimate_from_stats")
+        return max(int(est), _MIN_ESTIMATE), "stats"
     return estimate_plan_bytes(plan, context), "heuristic"
 
 

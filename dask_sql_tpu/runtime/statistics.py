@@ -1,0 +1,793 @@
+"""Table statistics + adaptive operator selection (ROADMAP item 3).
+
+One ``TableStats`` object — row count, per-column NDV estimate, min/max,
+null fraction, dense-int detection — collected cheaply at ingest
+(context.create_table) and refined by the runtime measurements already
+flowing through the flight recorder's EWMA history, threaded through the
+whole vertical:
+
+- **operator dispatch** (physical/rel/executor.py → ops/groupby.py,
+  ops/join.py, ops/kernels.py): the hash/sort crossover of "Hash-Based
+  vs. Sort-Based Group-By-Aggregate" (PAPERS.md) picks sorted-segment vs
+  hash aggregation from key NDV vs row count, and a dense-int
+  direct-index path (``codes = key - min``, no hashing — "Fine-Tuning
+  Data Structures for Analytical Query Processing", PAPERS.md) takes
+  over when the observed key domain is small and dense;
+- **planner** (plan/optimizer.py): join chains rank by estimated output
+  cardinality (NDV-based equi-join selectivity), and group-capacity
+  hints shrink the compiled executor's padded capacity classes toward
+  measured cardinality (physical/compiled.py, physical/stages.py);
+- **scheduler** (runtime/scheduler.py): ``estimate_plan_bytes`` consumes
+  the same stats for the admission reservation (``est_source=stats``).
+
+Every decision is advisory: the compiled path keeps its overflow-flag
+escalation net (a wrong cap hint costs one recompile, never a wrong
+result), the eager variants all produce the same group numbering as the
+status-quo factorize, and ``DSQL_ADAPTIVE=0`` restores pre-stats
+dispatch bit-for-bit.  ``DSQL_FORCE_GROUPBY=hash|sorted|dense`` pins the
+group-by variant for testing; every choice is recorded on the current
+span, a counter (``operator_choice_<op>_<variant>``), and EXPLAIN.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import telemetry as _tel
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# env gates
+# ---------------------------------------------------------------------------
+
+def adaptive_enabled() -> bool:
+    """Master kill-switch: ``DSQL_ADAPTIVE=0`` restores pre-stats dispatch
+    everywhere (collection still runs at ingest; it is pure metadata)."""
+    return os.environ.get("DSQL_ADAPTIVE", "1") != "0"
+
+
+def forced_groupby() -> Optional[str]:
+    """``DSQL_FORCE_GROUPBY=hash|sorted|dense``: pin the eager group-by
+    variant regardless of stats (testing/bench).  Unknown values → None."""
+    v = os.environ.get("DSQL_FORCE_GROUPBY", "").strip().lower()
+    return v if v in ("hash", "sorted", "dense") else None
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def dense_domain_cap() -> int:
+    """Largest key domain (max-min+1) the dense direct-index group-by will
+    allocate slots for; beyond it the crossover table decides."""
+    return _env_int("DSQL_DENSE_DOMAIN_CAP", 4096)
+
+
+#: domain above which exact ingest-time NDV probing (bincount) is skipped
+_NDV_PROBE_DOMAIN = 1 << 20
+#: sample size for the strided NDV estimator on wide-domain columns
+_NDV_SAMPLE = 65536
+#: sorted-segment aggregation stays profitable up to this many groups …
+SORT_NDV_CAP = 4096
+#: … and only while groups stay "fat" (ndv <= rows / SORT_ROW_FRACTION)
+SORT_ROW_FRACTION = 16
+
+
+# ---------------------------------------------------------------------------
+# the stats objects
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ColumnStats:
+    """Per-column ingest statistics.  ``ndv`` is an ESTIMATE above
+    ``_NDV_PROBE_DOMAIN``-sized domains (strided-sample extrapolation);
+    exact (bincount over the domain) for narrow integer columns —
+    exactly the columns the dense dispatch cares about."""
+
+    name: str
+    ndv: Optional[int] = None
+    min: Optional[float] = None
+    max: Optional[float] = None
+    null_frac: float = 0.0
+    is_int: bool = False
+    #: int column whose domain (max-min+1) fits dense_domain_cap()
+    dense: bool = False
+    domain: Optional[int] = None
+
+    def to_row(self) -> dict:
+        return {
+            "column": self.name,
+            "ndv": -1 if self.ndv is None else int(self.ndv),
+            "min": float("nan") if self.min is None else float(self.min),
+            "max": float("nan") if self.max is None else float(self.max),
+            "null_frac": float(self.null_frac),
+            "is_int": bool(self.is_int),
+            "dense": bool(self.dense),
+            "domain": -1 if self.domain is None else int(self.domain),
+        }
+
+
+@dataclass
+class TableStats:
+    rows: int = 0
+    cols: Dict[str, ColumnStats] = field(default_factory=dict)
+    collected_ms: float = 0.0
+
+    def col(self, name: str) -> Optional[ColumnStats]:
+        return self.cols.get(name)
+
+
+def collect_table_stats(table, row_valid=None) -> Optional[TableStats]:
+    """Cheap ingest-time collection over a resident device Table.
+
+    One host pass per column (XLA:CPU arrays view for free; on TPU this
+    runs once at create_table, not per query).  Never raises — a column
+    that resists profiling is simply absent from the stats dict, and any
+    failure returns None (the engine then behaves exactly as pre-stats).
+    """
+    t0 = time.perf_counter()
+    try:
+        rows = int(table.num_rows)
+        valid_rows = None
+        if row_valid is not None:
+            valid_rows = np.asarray(row_valid).reshape(-1)
+            rows = int(valid_rows.sum())
+        ts = TableStats(rows=rows)
+        for name, col in zip(table.names, table.columns):
+            cs = _collect_column(name, col, rows, valid_rows)
+            if cs is not None:
+                ts.cols[name] = cs
+        ts.collected_ms = (time.perf_counter() - t0) * 1e3
+        _tel.inc("stats_tables_collected")
+        return ts
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        logger.debug("stats collection failed", exc_info=True)
+        _tel.inc("stats_collect_errors")
+        return None
+
+
+def _collect_column(name, col, rows: int, valid_rows) -> Optional[ColumnStats]:
+    try:
+        mask = None if col.mask is None else np.asarray(col.mask).reshape(-1)
+        if valid_rows is not None:
+            mask = valid_rows if mask is None else (mask & valid_rows)
+        n = rows if rows else 1
+        nulls = 0 if mask is None else int(rows - mask.sum()) if valid_rows \
+            is None else int(valid_rows.sum() - mask.sum())
+        null_frac = max(0.0, min(1.0, nulls / n))
+
+        if col.stype.is_string:
+            # dictionary-encoded: the dictionary bounds NDV exactly
+            ndv = int(len(col.dictionary)) if col.dictionary is not None \
+                else None
+            return ColumnStats(name=name, ndv=ndv, null_frac=null_frac)
+
+        data = np.asarray(col.data).reshape(-1)
+        vals = data if mask is None else data[mask.astype(bool)]
+        if vals.size == 0:
+            return ColumnStats(name=name, ndv=0, null_frac=null_frac,
+                               is_int=bool(np.issubdtype(data.dtype,
+                                                         np.integer)))
+        if data.dtype == np.bool_:
+            return ColumnStats(name=name, ndv=int(np.unique(vals).size),
+                               min=float(vals.min()), max=float(vals.max()),
+                               null_frac=null_frac)
+        mn, mx = vals.min(), vals.max()
+        is_int = bool(np.issubdtype(data.dtype, np.integer))
+        domain = None
+        ndv: Optional[int] = None
+        if is_int:
+            domain = int(mx) - int(mn) + 1
+            if 0 < domain <= _NDV_PROBE_DOMAIN:
+                # exact NDV in O(n + domain): one bincount over the domain
+                counts = np.bincount((vals.astype(np.int64) - int(mn)),
+                                     minlength=domain)
+                ndv = int(np.count_nonzero(counts))
+        if ndv is None:
+            ndv = _sampled_ndv(vals)
+        dense = bool(is_int and domain is not None
+                     and domain <= dense_domain_cap())
+        mnf, mxf = float(mn), float(mx)
+        if not (math.isfinite(mnf) and math.isfinite(mxf)):
+            mnf = mxf = None  # type: ignore[assignment]
+        return ColumnStats(name=name, ndv=ndv, min=mnf, max=mxf,
+                           null_frac=null_frac, is_int=is_int, dense=dense,
+                           domain=domain)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        logger.debug("column stats failed for %s", name, exc_info=True)
+        return None
+
+
+def _sampled_ndv(vals: np.ndarray) -> int:
+    """Strided-sample NDV estimator for wide domains.
+
+    A high distinct fraction in the sample extrapolates linearly (key-like
+    columns really do have ~n distinct values); a low fraction is reported
+    as the sample's own count — a LOWER bound, which biases the crossover
+    toward sorted aggregation only when groups genuinely looked fat."""
+    n = vals.size
+    if n <= _NDV_SAMPLE:
+        return int(np.unique(vals).size)
+    stride = max(1, n // _NDV_SAMPLE)
+    sample = vals[::stride]
+    d = int(np.unique(sample).size)
+    s = sample.size
+    if d >= 0.5 * s:
+        return min(n, int(n * (d / s)))
+    return d
+
+
+# ---------------------------------------------------------------------------
+# plan-level estimation: column stats + cardinality through operators
+# ---------------------------------------------------------------------------
+
+def _scan_entry(rel, context):
+    schema = context.schema.get(rel.schema_name)
+    if schema is None:
+        return None
+    return schema.tables.get(rel.table_name)
+
+
+def table_stats_for_scan(rel, context) -> Optional[TableStats]:
+    entry = _scan_entry(rel, context)
+    return getattr(entry, "stats", None) if entry is not None else None
+
+
+def column_stats_for(rel, ordinal: int, context) -> Optional[ColumnStats]:
+    """Trace output ordinal ``ordinal`` of ``rel`` back to a base-table
+    column and return its ingest stats (None when the column is computed
+    or the lineage can't be followed — callers then use defaults)."""
+    from ..plan import nodes as N
+
+    if isinstance(rel, N.LogicalTableScan):
+        ts = table_stats_for_scan(rel, context)
+        if ts is None or ordinal >= len(rel.schema):
+            return None
+        return ts.col(rel.schema[ordinal].name)
+    if isinstance(rel, N.LogicalProject):
+        e = rel.exprs[ordinal] if ordinal < len(rel.exprs) else None
+        if isinstance(e, N.RexInputRef):
+            return column_stats_for(rel.input, e.index, context)
+        return None
+    if isinstance(rel, (N.LogicalFilter, N.LogicalSort)):
+        # filters/sorts keep values; NDV/min/max stay valid upper bounds
+        return column_stats_for(rel.input, ordinal, context)
+    if isinstance(rel, N.LogicalAggregate):
+        if ordinal < len(rel.group_keys):
+            return column_stats_for(rel.input, rel.group_keys[ordinal],
+                                    context)
+        return None
+    if isinstance(rel, N.LogicalJoin):
+        nl = len(rel.left.schema)
+        if rel.join_type in ("SEMI", "ANTI") or ordinal < nl:
+            return column_stats_for(rel.left, ordinal, context)
+        return column_stats_for(rel.right, ordinal - nl, context)
+    return None
+
+
+_DEFAULT_EQ_SEL = 0.1
+_DEFAULT_RANGE_SEL = 0.3
+_DEFAULT_SEL = 0.25
+_MIN_SEL = 5e-4
+
+
+def _literal_value(rex):
+    from ..plan import nodes as N
+
+    if isinstance(rex, N.RexLiteral):
+        v = rex.value
+        if isinstance(v, bool):
+            return float(v)
+        if isinstance(v, (int, float)):
+            return float(v)
+    return None
+
+
+def selectivity(rex, rel, context) -> float:
+    """Fraction of ``rel``'s rows estimated to satisfy ``rex`` —
+    textbook System-R style rules over the ingest min/max/NDV."""
+    from ..plan import nodes as N
+
+    if isinstance(rex, N.RexLiteral):
+        if rex.value is True:
+            return 1.0
+        if rex.value is False:
+            return 0.0
+        return _DEFAULT_SEL
+    if not isinstance(rex, N.RexCall):
+        return _DEFAULT_SEL
+    op = rex.op
+    if op == "AND":
+        s = 1.0
+        for o in rex.operands:
+            s *= selectivity(o, rel, context)
+        return max(s, _MIN_SEL)
+    if op == "OR":
+        s = 0.0
+        for o in rex.operands:
+            s += selectivity(o, rel, context)
+        return min(s, 1.0)
+    if op == "NOT":
+        return min(max(1.0 - selectivity(rex.operands[0], rel, context),
+                       _MIN_SEL), 1.0)
+    if op in ("IS NULL", "IS NOT NULL") and len(rex.operands) == 1:
+        o = rex.operands[0]
+        cs = column_stats_for(rel, o.index, context) \
+            if isinstance(o, N.RexInputRef) else None
+        nf = cs.null_frac if cs is not None else 0.05
+        return max(nf if op == "IS NULL" else 1.0 - nf, _MIN_SEL)
+    if op in ("=", "<>", "!=", "<", "<=", ">", ">=") \
+            and len(rex.operands) == 2:
+        a, b = rex.operands
+        ref, lit = (a, b) if isinstance(a, N.RexInputRef) else (b, a)
+        if not isinstance(ref, N.RexInputRef):
+            return _DEFAULT_SEL
+        cs = column_stats_for(rel, ref.index, context)
+        if op == "=":
+            if cs is not None and cs.ndv:
+                return max(1.0 / cs.ndv, _MIN_SEL)
+            return _DEFAULT_EQ_SEL
+        if op in ("<>", "!="):
+            if cs is not None and cs.ndv:
+                return max(1.0 - 1.0 / cs.ndv, _MIN_SEL)
+            return 1.0 - _DEFAULT_EQ_SEL
+        lv = _literal_value(lit)
+        if cs is None or lv is None or cs.min is None or cs.max is None \
+                or cs.max <= cs.min:
+            return _DEFAULT_RANGE_SEL
+        frac = (lv - cs.min) / (cs.max - cs.min)
+        if (op in ("<", "<=")) == (ref is a):
+            s = frac          # col < lit  (or lit > col)
+        else:
+            s = 1.0 - frac    # col > lit  (or lit < col)
+        return min(max(s, _MIN_SEL), 1.0)
+    return _DEFAULT_SEL
+
+
+def estimate_rows(rel, context, _depth: int = 0) -> Optional[float]:
+    """Estimated output cardinality of a plan subtree; None = unknown.
+
+    Ingest stats drive the base numbers; the flight recorder's EWMA
+    history (keyed by canonical plan fingerprint) REFINES the root of
+    each estimate with rows the engine actually measured for this exact
+    subtree shape on earlier runs."""
+    from ..plan import nodes as N
+
+    if _depth == 0:
+        measured = measured_rows(rel, context)
+        if measured is not None:
+            return float(measured)
+    if _depth > 64:
+        return None
+    if isinstance(rel, N.LogicalTableScan):
+        ts = table_stats_for_scan(rel, context)
+        if ts is not None:
+            return float(ts.rows)
+        entry = _scan_entry(rel, context)
+        if entry is None:
+            return None
+        chunked = getattr(entry, "chunked", None)
+        if chunked is not None:
+            return float(getattr(chunked, "n_rows", 0))
+        table = getattr(entry, "table", None)
+        return float(table.num_rows) if table is not None else None
+    if isinstance(rel, N.LogicalValues):
+        return float(len(rel.rows))
+    if isinstance(rel, N.LogicalFilter):
+        child = estimate_rows(rel.input, context, _depth + 1)
+        if child is None:
+            return None
+        return child * selectivity(rel.condition, rel.input, context)
+    if isinstance(rel, N.LogicalProject):
+        return estimate_rows(rel.input, context, _depth + 1)
+    if isinstance(rel, N.LogicalSort):
+        child = estimate_rows(rel.input, context, _depth + 1)
+        if child is None:
+            return None
+        if rel.limit is not None:
+            return min(child, float(rel.limit))
+        return child
+    if isinstance(rel, N.LogicalAggregate):
+        child = estimate_rows(rel.input, context, _depth + 1)
+        if not rel.group_keys:
+            return 1.0
+        if child is None:
+            return None
+        prod = 1.0
+        for k in rel.group_keys:
+            cs = column_stats_for(rel.input, k, context)
+            if cs is None or not cs.ndv:
+                return child  # unknown key: no group reduction claimed
+            prod *= cs.ndv
+            if prod > child:
+                return child
+        return min(child, prod)
+    if isinstance(rel, N.LogicalJoin):
+        return _estimate_join_rows(rel, context, _depth)
+    # set ops and anything else with inputs: sum of known inputs
+    if rel.inputs:
+        total = 0.0
+        for i in rel.inputs:
+            c = estimate_rows(i, context, _depth + 1)
+            if c is None:
+                return None
+            total += c
+        return total
+    return None
+
+
+def _equi_pairs(rel):
+    from ..plan.optimizer import split_join_condition
+    try:
+        equi, _residual = split_join_condition(rel)
+        return equi
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return []
+
+
+def _estimate_join_rows(rel, context, _depth: int) -> Optional[float]:
+    lrows = estimate_rows(rel.left, context, _depth + 1)
+    rrows = estimate_rows(rel.right, context, _depth + 1)
+    if lrows is None or rrows is None:
+        return None
+    jt = rel.join_type
+    if jt == "SEMI":
+        return lrows * 0.5
+    if jt == "ANTI":
+        return lrows * 0.5
+    out = lrows * rrows
+    for lk, rk in _equi_pairs(rel):
+        lcs = column_stats_for(rel.left, lk, context)
+        rcs = column_stats_for(rel.right, rk, context)
+        ndv = max(lcs.ndv if lcs is not None and lcs.ndv else 0,
+                  rcs.ndv if rcs is not None and rcs.ndv else 0)
+        out /= max(ndv, 10) if ndv else 10
+    if jt in ("LEFT", "FULL"):
+        out = max(out, lrows)
+    if jt in ("RIGHT", "FULL"):
+        out = max(out, rrows)
+    return max(out, 1.0)
+
+
+def measured_rows(rel, context) -> Optional[float]:
+    """EWMA-measured output rows for this exact subtree shape, when the
+    flight recorder has seen it (env-gated; zero cost when off)."""
+    if not os.environ.get("DSQL_HISTORY_FILE"):
+        return None
+    try:
+        from . import flight_recorder as _fr
+        fp = _fr.plan_fingerprint(rel, context)
+        if fp is None:
+            return None
+        stats = _fr.get_stats(fp)
+        if stats and stats.get("rows"):
+            return float(stats["rows"])
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        logger.debug("measured_rows failed", exc_info=True)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the crossover decision table (group-by dispatch)
+# ---------------------------------------------------------------------------
+
+def choose_groupby_variant(rows: Optional[float], ndv: Optional[float],
+                           dense_ok: bool) -> str:
+    """The hash/sort/dense crossover:
+
+    - ``dense``  — single int key over a small dense domain: direct index
+      (``codes = key - min``), no hashing, no sort;
+    - ``sorted`` — few fat groups (NDV <= min(SORT_NDV_CAP, rows/16)):
+      one stable lexsort + boundary scan beats building a table whose
+      size scales with NDV, and the sorted stream aggregates scatter-free;
+    - ``hash``   — everything else (the status-quo factorize path), and
+      the fallback whenever stats are unknown.
+    """
+    if dense_ok:
+        return "dense"
+    if rows is None or ndv is None:
+        return "hash"
+    if ndv <= min(SORT_NDV_CAP, rows / SORT_ROW_FRACTION):
+        return "sorted"
+    return "hash"
+
+
+def groupby_decision(rel, context) -> Tuple[str, Dict[str, Any]]:
+    """(variant, info) for a LogicalAggregate's eager dispatch.
+
+    ``info`` carries the driving stats for spans/EXPLAIN and, for the
+    dense variant, the (lo, hi) domain hint so the kernel skips its own
+    min/max probe.  Forced (``DSQL_FORCE_GROUPBY``) overrides everything;
+    adaptive off (or no usable stats) keeps the status quo ("hash")."""
+    info: Dict[str, Any] = {}
+    forced = forced_groupby()
+    if forced is not None:
+        info["forced"] = 1
+        return forced, info
+    if not adaptive_enabled() or not rel.group_keys:
+        return "hash", info
+    rows = estimate_rows(rel.input, context)
+    ndv: Optional[float] = 1.0
+    dense_ok = False
+    for k in rel.group_keys:
+        cs = column_stats_for(rel.input, k, context)
+        if cs is None or not cs.ndv:
+            ndv = None
+            break
+        ndv *= cs.ndv
+    if len(rel.group_keys) == 1:
+        cs = column_stats_for(rel.input, rel.group_keys[0], context)
+        if cs is not None and cs.dense and cs.min is not None \
+                and cs.max is not None:
+            dense_ok = True
+            info["lo"] = int(cs.min)
+            info["hi"] = int(cs.max)
+    if rows is not None:
+        info["rows"] = int(rows)
+    if ndv is not None:
+        info["ndv"] = int(ndv)
+    return choose_groupby_variant(rows, ndv, dense_ok), info
+
+
+def join_decision(rel, left_cols, right_cols, context
+                  ) -> Tuple[str, Dict[str, Any]]:
+    """(variant, info) for an equi join's key factorization: ``dense``
+    skips the shared-domain sort entirely when the single key pair is
+    integer-typed (``codes = key - min`` on both sides); anything else
+    keeps the status-quo shared factorize ("hash")."""
+    import jax.numpy as jnp
+
+    info: Dict[str, Any] = {}
+    if not adaptive_enabled() or len(left_cols) != 1:
+        return "hash", info
+    lc, rc = left_cols[0], right_cols[0]
+    if lc.stype.is_string or rc.stype.is_string:
+        return "hash", info
+    if not (jnp.issubdtype(lc.data.dtype, jnp.integer)
+            and jnp.issubdtype(rc.data.dtype, jnp.integer)):
+        return "hash", info
+    if context is not None and rel is not None:
+        lrows = estimate_rows(rel.left, context)
+        rrows = estimate_rows(rel.right, context)
+        if lrows is not None:
+            info["lrows"] = int(lrows)
+        if rrows is not None:
+            info["rrows"] = int(rrows)
+    return "dense", info
+
+
+# ---------------------------------------------------------------------------
+# compiled-path capacity hints (physical/compiled.py, physical/stages.py)
+# ---------------------------------------------------------------------------
+
+def _pad_pow2(n: int, lo: int = 64, hi: int = 1 << 20) -> int:
+    n = max(int(n), 1)
+    return min(max(1 << (n - 1).bit_length(), lo), hi)
+
+
+def compiled_cap_hints(plan, context) -> Dict[str, int]:
+    """Stats-derived starting caps for the compiled executor's padded
+    group-capacity classes.
+
+    Tags are assigned in trace order (``agg0``, ``agg1``, …), which this
+    host-side walk cannot reproduce for arbitrary plans (scalar
+    subqueries interleave), so hints are only offered when the plan holds
+    EXACTLY ONE grouped aggregate — unambiguously ``agg0`` — which covers
+    the single-agg stage programs the partitioner produces.  A wrong hint
+    is always safe: too small trips the overflow flag into one
+    capacity-escalation recompile, too large is just the old padding."""
+    if not adaptive_enabled() or forced_groupby() is not None:
+        return {}
+    from ..plan import nodes as N
+
+    aggs: List[Any] = []
+
+    def walk(rel) -> None:
+        if isinstance(rel, N.LogicalAggregate) and rel.group_keys:
+            aggs.append(rel)
+        for i in rel.inputs:
+            walk(i)
+
+    try:
+        walk(plan)
+        if len(aggs) != 1:
+            return {}
+        rel = aggs[0]
+        groups = estimate_rows(rel, context)
+        if groups is None:
+            return {}
+        return {"agg0": _pad_pow2(int(groups * 1.25) + 1)}
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        logger.debug("cap hints failed", exc_info=True)
+        return {}
+
+
+def estimate_plan_bytes_stats(plan, context) -> Optional[int]:
+    """Stats-driven working-set estimate for the scheduler: the resident
+    scan bytes (they are touched regardless) plus every heavy operator's
+    estimated output (rows × 9 bytes/column — 8 data + amortized mask).
+    None when adaptive is off or the plan's cardinality can't be
+    estimated — the caller keeps the shape heuristic."""
+    if not adaptive_enabled():
+        return None
+    from ..plan import nodes as N
+
+    try:
+        scan_bytes = 0
+        inter_bytes = 0.0
+        ok = True
+        stack = [plan]
+        while stack:
+            rel = stack.pop()
+            if isinstance(rel, N.LogicalTableScan):
+                entry = _scan_entry(rel, context)
+                if entry is not None:
+                    from .scheduler import _entry_bytes
+                    scan_bytes += _entry_bytes(entry)
+            elif isinstance(rel, (N.LogicalJoin, N.LogicalAggregate,
+                                  N.LogicalWindow, N.LogicalSort)):
+                est = estimate_rows(rel, context)
+                if est is None:
+                    ok = False
+                    break
+                inter_bytes += est * max(len(rel.schema), 1) * 9
+            stack.extend(getattr(rel, "inputs", ()) or ())
+        if not ok:
+            return None
+        return int(scan_bytes + inter_bytes)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        logger.debug("stats byte estimate failed", exc_info=True)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# choice recording: counters + spans + an optional thread-local capture
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+@contextmanager
+def capture():
+    """Collect every record_choice() on this thread (EXPLAIN ANALYZE's
+    eager run uses it to print the choices the run actually took)."""
+    prev = getattr(_tls, "capture", None)
+    buf: List[Tuple[str, str, Dict[str, Any]]] = []
+    _tls.capture = buf
+    try:
+        yield buf
+    finally:
+        _tls.capture = prev
+
+
+def record_choice(op: str, variant: str, **info) -> None:
+    """One dispatch decision: counter ``operator_choice_<op>_<variant>``,
+    an ``operators`` list entry on the current span (flows into
+    QueryReport / flight-recorder envelopes / system.queries / the wire),
+    and the thread-local capture buffer when one is open."""
+    _tel.inc(f"operator_choice_{op}_{variant}")
+    line = format_choice(op, variant, info)
+    span = _tel.current_span()
+    if span is not None:
+        span.attrs.setdefault("operators", []).append(line)
+    buf = getattr(_tls, "capture", None)
+    if buf is not None:
+        buf.append((op, variant, dict(info)))
+
+
+def format_choice(op: str, variant: str, info: Dict[str, Any]) -> str:
+    parts = [f"{op}={variant}"]
+    for k in sorted(info):
+        parts.append(f"{k}={info[k]}")
+    return " ".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN surface
+# ---------------------------------------------------------------------------
+
+def explain_lines(plan, context) -> List[str]:
+    """``-- operator:`` trailer lines for plain EXPLAIN: the variant each
+    group-by/join WOULD take under current stats (EXPLAIN ANALYZE prints
+    the measured choices instead).  Silent when adaptive is off."""
+    if not adaptive_enabled() and forced_groupby() is None:
+        return []
+    from ..plan import nodes as N
+
+    lines: List[str] = []
+
+    def walk(rel) -> None:
+        for i in rel.inputs:
+            walk(i)
+        if isinstance(rel, N.LogicalAggregate) and rel.group_keys:
+            try:
+                variant, info = groupby_decision(rel, context)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                return
+            lines.append("-- operator: "
+                         + format_choice("groupby", variant, info))
+        elif isinstance(rel, N.LogicalJoin):
+            pairs = _equi_pairs(rel)
+            if len(pairs) != 1:
+                return
+            try:
+                lk, rk = pairs[0]
+                lcs = column_stats_for(rel.left, lk, context)
+                rcs = column_stats_for(rel.right, rk, context)
+                dense = bool(lcs is not None and rcs is not None
+                             and lcs.is_int and rcs.is_int
+                             and adaptive_enabled())
+                info: Dict[str, Any] = {}
+                lrows = estimate_rows(rel.left, context)
+                rrows = estimate_rows(rel.right, context)
+                if lrows is not None:
+                    info["lrows"] = int(lrows)
+                if rrows is not None:
+                    info["rrows"] = int(rrows)
+                lines.append("-- operator: " + format_choice(
+                    "join", "dense" if dense else "hash", info))
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                return
+
+    try:
+        walk(plan)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return []
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# system.table_stats export
+# ---------------------------------------------------------------------------
+
+def system_rows(context) -> List[dict]:
+    """One row per (schema, table, column) with ingest stats — the
+    ``system.table_stats`` builder's payload."""
+    rows: List[dict] = []
+    for schema_name, schema in sorted(context.schema.items()):
+        for table_name, entry in sorted(schema.tables.items()):
+            ts = getattr(entry, "stats", None)
+            if ts is None:
+                continue
+            base = {"schema": schema_name, "table": table_name,
+                    "rows": int(ts.rows),
+                    "collected_ms": float(ts.collected_ms)}
+            if not ts.cols:
+                rows.append({**base, "column": "", "ndv": -1,
+                             "min": float("nan"), "max": float("nan"),
+                             "null_frac": 0.0, "is_int": False,
+                             "dense": False, "domain": -1})
+            for name in ts.cols:
+                rows.append({**base, **ts.cols[name].to_row()})
+    return rows

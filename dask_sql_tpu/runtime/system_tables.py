@@ -28,7 +28,7 @@ import numpy as np
 from ..table import Table
 
 TABLE_NAMES = ("queries", "active", "metrics", "cache", "quarantine",
-               "programs")
+               "programs", "table_stats")
 
 
 def _col(rows: List[dict], key: str, dtype, default):
@@ -66,6 +66,12 @@ def _queries() -> Table:
         "est_source": _col(rows, "est_source", object, ""),
         "queued_ms": _col(rows, "queued_ms", np.float64, 0.0),
         "plan_fp": _col(rows, "plan_fp", object, ""),
+        # adaptive operator choices, "; "-joined record_choice lines
+        # ("groupby=dense rows=... ndv=..."); older envelopes lack the
+        # field and render empty
+        "operators": _col([{"operators": "; ".join(r.get("operators")
+                                                   or [])}
+                           for r in rows], "operators", object, ""),
     })
 
 
@@ -167,6 +173,31 @@ def _programs() -> Table:
     })
 
 
+def _table_stats(context=None) -> Table:
+    """Ingest-time TableStats (runtime/statistics.py) for every resident
+    catalog table: one row per column with NDV / min / max / null fraction
+    / dense-domain flags — the numbers adaptive operator selection runs
+    on.  Needs the resolving context (the catalog lives there); a
+    context-less build yields the empty schema."""
+    from . import statistics as _stats
+
+    rows = _stats.system_rows(context) if context is not None else []
+    return Table.from_pydict({
+        "schema": _col(rows, "schema", object, ""),
+        "table": _col(rows, "table", object, ""),
+        "column": _col(rows, "column", object, ""),
+        "rows": _col(rows, "rows", np.int64, 0),
+        "ndv": _col(rows, "ndv", np.int64, -1),
+        "min": _col(rows, "min", np.float64, float("nan")),
+        "max": _col(rows, "max", np.float64, float("nan")),
+        "null_frac": _col(rows, "null_frac", np.float64, 0.0),
+        "is_int": _col(rows, "is_int", np.bool_, False),
+        "dense": _col(rows, "dense", np.bool_, False),
+        "domain": _col(rows, "domain", np.int64, -1),
+        "collected_ms": _col(rows, "collected_ms", np.float64, 0.0),
+    })
+
+
 _BUILDERS: Dict[str, object] = {
     "queries": _queries,
     "active": _active,
@@ -174,6 +205,7 @@ _BUILDERS: Dict[str, object] = {
     "cache": _cache,
     "quarantine": _quarantine,
     "programs": _programs,
+    "table_stats": _table_stats,
 }
 
 
@@ -183,4 +215,6 @@ def build(name: str, context=None) -> Optional[Table]:
     builder = _BUILDERS.get(name.lower())
     if builder is None:
         return None
+    if builder is _table_stats:
+        return _table_stats(context)
     return builder()  # type: ignore[operator]

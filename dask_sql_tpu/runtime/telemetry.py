@@ -485,7 +485,7 @@ class QueryReport:
 
     __slots__ = ("query", "wall_ms", "phases", "counters", "root",
                  "rows_out", "bytes_out", "started_unix", "cache", "tier",
-                 "priority")
+                 "priority", "operators")
 
     def __init__(self, trace: QueryTrace):
         root = trace.root
@@ -542,6 +542,14 @@ class QueryReport:
                 priority = str(p) if p is not None else None
         self.tier = exec_tier
         self.priority = priority
+        # adaptive operator choices (runtime/statistics.py record_choice
+        # appends "groupby=dense ..." lines to span attrs) in span order
+        operators: List[str] = []
+        for s in root.walk():
+            ops = s.attrs.get("operators")
+            if ops:
+                operators.extend(str(o) for o in ops)
+        self.operators = operators
         self.cache = {"hit": hit, "tier": tier, "stored": stored,
                       "subplan_hits": subplan_hits,
                       "bytes": int(REGISTRY.get_gauge("result_cache_bytes")),
@@ -558,6 +566,7 @@ class QueryReport:
                 "cache": dict(self.cache),
                 "tier": self.tier,
                 "priority": self.priority,
+                "operators": list(self.operators),
                 "rows_out": self.rows_out, "bytes_out": self.bytes_out,
                 "spans": self.root.to_dict()}
 
@@ -572,6 +581,8 @@ class QueryReport:
         if self.counters:
             lines.append("counters: " + "  ".join(
                 f"{k}=+{v}" for k, v in sorted(self.counters.items())))
+        if self.operators:
+            lines.append("operators: " + "; ".join(self.operators))
 
         def walk(s: Span, depth: int):
             attrs = "".join(f" {k}={v}" for k, v in sorted(s.attrs.items()))

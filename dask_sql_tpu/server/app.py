@@ -145,6 +145,10 @@ def _stats(state: str, info: Optional["_QueryInfo"] = None) -> dict:
             out["tier"] = info.tier
         if info.program_store_hits:
             out["programStoreHits"] = info.program_store_hits
+        # adaptive operator choices this query's dispatch took
+        # (runtime/statistics.py record_choice, via the QueryReport)
+        if info.operators:
+            out["operatorChoices"] = list(info.operators)
         if info.phases:
             # per-query phase breakdown from the query's own QueryReport
             # (race-free: the report is thread-local to the worker that
@@ -158,7 +162,7 @@ class _QueryInfo:
     __slots__ = ("submitted", "started", "finished", "cpu_sec", "rows",
                  "bytes", "peak_memory", "compiles", "cache_hits", "phases",
                  "cache_hit", "cache_tier", "subplan_cache_hits",
-                 "queued_ms", "tier", "program_store_hits")
+                 "queued_ms", "tier", "program_store_hits", "operators")
 
     def __init__(self):
         self.submitted = time.monotonic()
@@ -177,6 +181,7 @@ class _QueryInfo:
         self.queued_ms = None
         self.tier = None
         self.program_store_hits = 0
+        self.operators = []
 
 
 def _run_tracked(context, sql: str, info: _QueryInfo,
@@ -222,6 +227,7 @@ def _run_tracked(context, sql: str, info: _QueryInfo,
             info.tier = getattr(report, "tier", None)
             info.program_store_hits = int(
                 (report.counters or {}).get("program_store_hits", 0))
+            info.operators = list(getattr(report, "operators", ()) or ())
     if table is not None and getattr(table, "num_columns", 0):
         info.rows = table.num_rows
         info.bytes = sum(int(getattr(c.data, "nbytes", 0))

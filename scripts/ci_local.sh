@@ -64,6 +64,12 @@ echo "=== [2g] warm-start smoke (tiered execution + program store) ==="
 # without blocking, then run compiled on the next arrival
 python scripts/warmstart_smoke.py
 
+echo "=== [2h] stats smoke (adaptive operator selection) ==="
+# dense direct-index must beat forced hash on a 2M-row dense-key
+# aggregate, all forced variants must agree, the stats join reorder must
+# attach the fact table last, and DSQL_ADAPTIVE=0 must restore baseline
+python scripts/stats_smoke.py
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \

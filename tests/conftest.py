@@ -148,6 +148,23 @@ def _history_off(request, monkeypatch):
     yield
 
 
+@pytest.fixture(autouse=True)
+def _adaptive_off(request, monkeypatch):
+    """Statistics-driven adaptive operator selection (runtime/statistics.py,
+    on by default in production) changes which group-by/join kernel runs
+    and how join chains are ordered — which would perturb every
+    pre-existing suite's plan/counter/span assumptions.  Mirroring the
+    cache/scheduler/tiering pins: non-adaptive suites run with the
+    DSQL_ADAPTIVE=0 kill-switch pinned (plus any leaked DSQL_FORCE_GROUPBY
+    cleared), the dedicated adaptive/statistics suites arm it explicitly,
+    and scripts/stats_smoke.py gates the production-default path."""
+    name = request.module.__name__
+    if "adaptive" not in name and "statistic" not in name:
+        monkeypatch.setenv("DSQL_ADAPTIVE", "0")
+        monkeypatch.delenv("DSQL_FORCE_GROUPBY", raising=False)
+    yield
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bounded_executable_lifetime():
     yield

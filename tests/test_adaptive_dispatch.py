@@ -1,0 +1,278 @@
+"""Integration tests for statistics-driven adaptive operator selection.
+
+Pandas-oracle parity across ALL forced group-by variants on TPC-H-shaped
+queries, dense direct-index proof (counter + EXPLAIN line), high-NDV
+fallback to hash, the DSQL_ADAPTIVE=0 kill switch, and the
+system.table_stats / QueryReport surfaces.
+
+The module name contains "adaptive", so conftest's _adaptive_off pin
+leaves production defaults alone here; each test sets exactly the env it
+asserts.  DSQL_COMPILE=0 where a test asserts EAGER dispatch counters —
+the compiled path fuses the whole plan and never reaches the eager
+group_codes dispatch.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu.runtime import telemetry as _tel
+
+from tests.conftest import assert_eq
+
+VARIANTS = ("hash", "sorted", "dense")
+
+
+def _counters():
+    return dict(_tel.REGISTRY.counters())
+
+
+def _delta(before, key):
+    return _tel.REGISTRY.counters().get(key, 0) - before.get(key, 0)
+
+
+@pytest.fixture()
+def tpch_ctx():
+    """A small TPC-H-shaped catalog: lineitem fact + part/orders dims."""
+    np.random.seed(7)
+    n = 6000
+    lineitem = pd.DataFrame({
+        "l_orderkey": np.random.randint(0, 1500, n),
+        "l_partkey": np.random.randint(0, 200, n),
+        "l_quantity": np.random.randint(1, 51, n).astype("float64"),
+        "l_extendedprice": np.round(np.random.rand(n) * 1e4, 2),
+        "l_discount": np.round(np.random.rand(n) * 0.1, 2),
+        "l_returnflag": np.random.choice(["A", "N", "R"], n),
+        "l_linestatus": np.random.choice(["O", "F"], n),
+    })
+    orders = pd.DataFrame({
+        "o_orderkey": np.arange(1500),
+        "o_custkey": np.random.randint(0, 150, 1500),
+        "o_totalprice": np.round(np.random.rand(1500) * 1e5, 2),
+    })
+    part = pd.DataFrame({
+        "p_partkey": np.arange(200),
+        "p_size": np.random.randint(1, 50, 200),
+    })
+    ctx = Context()
+    ctx.create_table("lineitem", lineitem)
+    ctx.create_table("orders", orders)
+    ctx.create_table("part", part)
+    return ctx, {"lineitem": lineitem, "orders": orders, "part": part}
+
+
+Q1_SHAPED = (
+    "SELECT l_returnflag, l_linestatus, SUM(l_quantity) AS sum_qty, "
+    "SUM(l_extendedprice * (1 - l_discount)) AS sum_disc, "
+    "AVG(l_quantity) AS avg_qty, COUNT(*) AS count_order "
+    "FROM lineitem GROUP BY l_returnflag, l_linestatus"
+)
+
+DENSE_KEY_AGG = (
+    "SELECT l_partkey, SUM(l_quantity) AS s, COUNT(*) AS n, "
+    "MIN(l_extendedprice) AS mn, MAX(l_extendedprice) AS mx "
+    "FROM lineitem GROUP BY l_partkey"
+)
+
+JOIN_AGG = (
+    "SELECT o_custkey, SUM(l_extendedprice) AS rev "
+    "FROM lineitem, orders WHERE l_orderkey = o_orderkey "
+    "GROUP BY o_custkey"
+)
+
+
+def _oracle_q1(frames):
+    li = frames["lineitem"].copy()
+    li["disc"] = li["l_extendedprice"] * (1 - li["l_discount"])
+    return (li.groupby(["l_returnflag", "l_linestatus"])
+            .agg(sum_qty=("l_quantity", "sum"), sum_disc=("disc", "sum"),
+                 avg_qty=("l_quantity", "mean"),
+                 count_order=("l_quantity", "size")).reset_index())
+
+
+def _oracle_dense(frames):
+    return (frames["lineitem"].groupby("l_partkey")
+            .agg(s=("l_quantity", "sum"), n=("l_quantity", "size"),
+                 mn=("l_extendedprice", "min"),
+                 mx=("l_extendedprice", "max")).reset_index())
+
+
+def _oracle_join_agg(frames):
+    j = frames["lineitem"].merge(frames["orders"],
+                                 left_on="l_orderkey", right_on="o_orderkey")
+    return (j.groupby("o_custkey")
+            .agg(rev=("l_extendedprice", "sum")).reset_index())
+
+
+@pytest.mark.parametrize("variant", VARIANTS)
+@pytest.mark.parametrize("sql,oracle", [
+    (Q1_SHAPED, _oracle_q1),
+    (DENSE_KEY_AGG, _oracle_dense),
+    (JOIN_AGG, _oracle_join_agg),
+], ids=["q1-shaped", "dense-key", "join-agg"])
+def test_forced_variant_pandas_parity(tpch_ctx, monkeypatch, sql, oracle,
+                                      variant):
+    """Every forced variant must agree with the pandas oracle — the
+    group-numbering parity invariant, end to end.  (A variant that does
+    not apply — dense over string keys — falls through and must STILL
+    agree.)"""
+    monkeypatch.setenv("DSQL_COMPILE", "0")
+    monkeypatch.setenv("DSQL_FORCE_GROUPBY", variant)
+    ctx, frames = tpch_ctx
+    assert_eq(ctx.sql(sql), oracle(frames), check_row_order=False)
+
+
+def test_dense_key_takes_direct_index_path(tpch_ctx, monkeypatch):
+    """Acceptance: a dense small-domain key PROVABLY takes the dense
+    direct-index path — counter + EXPLAIN line, not just equal output."""
+    monkeypatch.setenv("DSQL_COMPILE", "0")
+    ctx, frames = tpch_ctx
+    before = _counters()
+    assert_eq(ctx.sql(DENSE_KEY_AGG), _oracle_dense(frames),
+              check_row_order=False)
+    assert _delta(before, "operator_choice_groupby_dense") >= 1
+    text = ctx.sql("EXPLAIN " + DENSE_KEY_AGG) \
+              .to_pandas()["PLAN"].str.cat(sep="\n")
+    assert "-- operator: groupby=dense" in text
+    assert "ndv=" in text and "rows=" in text
+
+
+def test_high_ndv_takes_hash(monkeypatch):
+    """Acceptance: a high-NDV key (near-unique, wide domain) stays on
+    hash aggregation."""
+    monkeypatch.setenv("DSQL_COMPILE", "0")
+    n = 50_000
+    ctx = Context()
+    ctx.create_table("t", pd.DataFrame({
+        "k": np.arange(n, dtype=np.int64) * 1001,  # wide domain, ndv = n
+        "v": np.random.rand(n)}))
+    before = _counters()
+    ctx.sql("SELECT k, SUM(v) FROM t GROUP BY k")
+    assert _delta(before, "operator_choice_groupby_hash") >= 1
+    assert _delta(before, "operator_choice_groupby_dense") == 0
+    assert _delta(before, "operator_choice_groupby_sorted") == 0
+
+
+def test_sorted_crossover_fat_groups(monkeypatch):
+    """Low NDV over a wide (non-dense) domain with fat groups crosses to
+    sorted-segment aggregation."""
+    monkeypatch.setenv("DSQL_COMPILE", "0")
+    n = 40_000
+    keys = (np.arange(n, dtype=np.int64) % 20) * 10**7  # ndv=20, wide
+    ctx = Context()
+    df = pd.DataFrame({"k": keys, "v": np.random.rand(n)})
+    ctx.create_table("t", df)
+    before = _counters()
+    got = ctx.sql("SELECT k, SUM(v) AS s FROM t GROUP BY k")
+    assert _delta(before, "operator_choice_groupby_sorted") >= 1
+    assert_eq(got, df.groupby("k").agg(s=("v", "sum")).reset_index(),
+              check_row_order=False)
+
+
+def test_dense_join_direct_index(monkeypatch):
+    """Small/dense single-int join keys take the dense join coding, with
+    the choice recorded."""
+    monkeypatch.setenv("DSQL_COMPILE", "0")
+    np.random.seed(3)
+    left = pd.DataFrame({"k": np.random.randint(0, 64, 4000),
+                         "a": np.random.rand(4000)})
+    right = pd.DataFrame({"k": np.arange(64), "b": np.random.rand(64)})
+    ctx = Context()
+    ctx.create_table("l", left)
+    ctx.create_table("r", right)
+    before = _counters()
+    got = ctx.sql("SELECT l.k, a, b FROM l, r WHERE l.k = r.k")
+    assert _delta(before, "operator_choice_join_dense") >= 1
+    exp = left.merge(right, on="k")[["k", "a", "b"]]
+    assert_eq(got, exp, check_row_order=False)
+
+
+def test_adaptive_off_restores_baseline(tpch_ctx, monkeypatch):
+    """DSQL_ADAPTIVE=0: no adaptive counters move, no EXPLAIN trailer,
+    and results match the oracle (status-quo hash dispatch)."""
+    monkeypatch.setenv("DSQL_COMPILE", "0")
+    monkeypatch.setenv("DSQL_ADAPTIVE", "0")
+    monkeypatch.delenv("DSQL_FORCE_GROUPBY", raising=False)
+    ctx, frames = tpch_ctx
+    before = _counters()
+    assert_eq(ctx.sql(DENSE_KEY_AGG), _oracle_dense(frames),
+              check_row_order=False)
+    assert_eq(ctx.sql(JOIN_AGG), _oracle_join_agg(frames),
+              check_row_order=False)
+    for key in ("operator_choice_groupby_dense",
+                "operator_choice_groupby_sorted",
+                "operator_choice_join_dense",
+                "operator_choice_join_order_stats"):
+        assert _delta(before, key) == 0, key
+    text = ctx.sql("EXPLAIN " + DENSE_KEY_AGG) \
+              .to_pandas()["PLAN"].str.cat(sep="\n")
+    assert "-- operator:" not in text
+
+
+def test_forced_beats_kill_switch_precedence(tpch_ctx, monkeypatch):
+    """DSQL_FORCE_GROUPBY works even with DSQL_ADAPTIVE=0 (explicit
+    operator pinning is an operator decision, not an adaptive one)."""
+    monkeypatch.setenv("DSQL_COMPILE", "0")
+    monkeypatch.setenv("DSQL_ADAPTIVE", "0")
+    monkeypatch.setenv("DSQL_FORCE_GROUPBY", "dense")
+    ctx, frames = tpch_ctx
+    before = _counters()
+    assert_eq(ctx.sql(DENSE_KEY_AGG), _oracle_dense(frames),
+              check_row_order=False)
+    assert _delta(before, "operator_choice_groupby_dense") >= 1
+
+
+def test_system_table_stats_queryable(tpch_ctx):
+    ctx, frames = tpch_ctx
+    df = ctx.sql(
+        'SELECT "table", "column", ndv, dense, "rows" '
+        "FROM system.table_stats WHERE \"table\" = 'lineitem'"
+    ).to_pandas()
+    row = df[df["column"] == "l_partkey"].iloc[0]
+    assert bool(row["dense"])
+    assert int(row["ndv"]) == frames["lineitem"]["l_partkey"].nunique()
+    assert int(row["rows"]) == len(frames["lineitem"])
+
+
+def test_query_report_carries_operators(tpch_ctx, monkeypatch):
+    monkeypatch.setenv("DSQL_COMPILE", "0")
+    ctx, _ = tpch_ctx
+    ctx.sql(DENSE_KEY_AGG)
+    rep = _tel.last_report()
+    assert rep is not None
+    assert any(op.startswith("groupby=dense") for op in rep.operators)
+    assert rep.to_dict()["operators"] == rep.operators
+
+
+def test_explain_analyze_prints_measured_choices(tpch_ctx, monkeypatch):
+    monkeypatch.setenv("DSQL_COMPILE", "0")
+    ctx, _ = tpch_ctx
+    text = ctx.sql("EXPLAIN ANALYZE " + DENSE_KEY_AGG) \
+              .to_pandas()["PLAN"].str.cat(sep="\n")
+    assert "-- operator: groupby=dense" in text
+
+
+def test_compiled_parity_with_cap_hints(tpch_ctx):
+    """The compiled path with stats cap hints agrees with the oracle —
+    a too-small hint must escalate, never corrupt."""
+    ctx, frames = tpch_ctx
+    assert_eq(ctx.sql(DENSE_KEY_AGG), _oracle_dense(frames),
+              check_row_order=False)
+    assert_eq(ctx.sql(Q1_SHAPED), _oracle_q1(frames),
+              check_row_order=False)
+
+
+def test_null_keys_parity_all_variants(monkeypatch):
+    """NULL group keys keep parity on every variant (NULL-first
+    numbering is part of the shared invariant)."""
+    monkeypatch.setenv("DSQL_COMPILE", "0")
+    df = pd.DataFrame({"k": pd.array([2, None, 1, 2, None, 1, 3], "Int64"),
+                       "v": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0]})
+    exp = (df.groupby("k", dropna=False).agg(s=("v", "sum"))
+           .reset_index())
+    for variant in VARIANTS:
+        monkeypatch.setenv("DSQL_FORCE_GROUPBY", variant)
+        ctx = Context()
+        ctx.create_table("t", df)
+        assert_eq(ctx.sql("SELECT k, SUM(v) AS s FROM t GROUP BY k"),
+                  exp, check_row_order=False)
