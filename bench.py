@@ -222,6 +222,59 @@ def _stage_main():
         sys.stderr.flush()
         os._exit(0)
 
+    if os.environ.get("BENCH_SHARD_SCALING") == "1":
+        # SHARD-SCALING mode: the scan/agg-shaped queries (Q1/Q6) on the
+        # single-device engine vs row-sharded over the full mesh through
+        # the explicit SPMD executor (parallel/spmd.py) — the multi-chip
+        # speedup evidence for the BENCH_r*.json trajectory.  On a
+        # CPU-only host the mesh is the 8-virtual-device dry-run analogue;
+        # spmd_served certifies the sharded path (not a silent fallback)
+        # produced the numbers.
+        from dask_sql_tpu.parallel.mesh import default_mesh
+        from dask_sql_tpu.runtime import telemetry as _stel
+
+        mesh = default_mesh()
+        n_dev = int(mesh.devices.size)
+        if n_dev < 2:
+            emit({"shard_scaling_skip": f"only {n_dev} device(s)"})
+            os._exit(0)
+        dist = Context(mesh=mesh)
+        for name, frame in _load_data(os.environ["BENCH_DATA_DIR"]).items():
+            dist.create_table(name, frame)
+        reps = int(os.environ.get("BENCH_SHARD_REPS", "3"))
+        scaling = {}
+        for qid in (1, 6):
+            if left() < 20:
+                break
+            try:
+                c.sql(QUERIES[qid], return_futures=False)     # warm 1-dev
+                dist.sql(QUERIES[qid], return_futures=False)  # warm mesh
+                c0 = _stel.REGISTRY.counters()
+                single = sharded = float("inf")
+                for _ in range(reps):
+                    t0r = time.perf_counter()
+                    c.sql(QUERIES[qid], return_futures=False)
+                    single = min(single, time.perf_counter() - t0r)
+                    t0r = time.perf_counter()
+                    dist.sql(QUERIES[qid], return_futures=False)
+                    sharded = min(sharded, time.perf_counter() - t0r)
+                c1 = _stel.REGISTRY.counters()
+                served = (c1.get("spmd_queries", 0)
+                          - c0.get("spmd_queries", 0))
+                scaling[str(qid)] = {
+                    "single_sec": round(single, 4),
+                    "sharded_sec": round(sharded, 4),
+                    "speedup": round(single / max(sharded, 1e-9), 3),
+                    "devices": n_dev,
+                    "spmd_served": served >= reps,
+                }
+            except Exception as e:
+                emit({"shard_scaling_fail": qid, "error": repr(e)[:200]})
+        emit({"shard_scaling": scaling})
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
+
     # warmup = compilation; compiles overlap across threads (tracing holds
     # the GIL but the backend compile releases it), which matters on the
     # tunneled TPU where a single cold compile can take minutes.  Each
@@ -691,6 +744,7 @@ def main():
         query_ops, op_counters = {}, {}
         first_arrival, restart_times, restart_info = {}, {}, {}
         est_err, est_err_admitted, est_from_hist = {}, {}, None
+        shard_scaling = None
         load_sec = warmup_sec = 0.0
         try:
             with open(state["progress"]) as f:
@@ -737,6 +791,11 @@ def main():
                         restart_times[rec["restart_q"]] = rec["sec"]
                     elif rec.get("restart_done"):
                         restart_info = rec
+                    elif "shard_scaling" in rec:
+                        shard_scaling = rec["shard_scaling"] or None
+                    elif "shard_scaling_skip" in rec:
+                        shard_scaling = {"skipped":
+                                         rec["shard_scaling_skip"]}
                     elif "estimate_error" in rec:
                         est_err = rec["estimate_error"] or {}
                         est_err_admitted = \
@@ -856,6 +915,10 @@ def main():
                     "restart_warm_sec": {str(k): restart_times[k]
                                          for k in sorted(restart_times)},
                     "warm_start_sec": restart_info.get("warm_start_sec"),
+                    # multi-chip evidence (parallel/spmd.py): Q1/Q6 wall
+                    # time single-device vs row-sharded over the mesh,
+                    # with spmd_served certifying the sharded path ran
+                    "shard_scaling": shard_scaling,
                     "program_store_hit_rate": (
                         round(restart_info["program_store_hits"]
                               / max(restart_info["program_store_hits"]
@@ -1177,6 +1240,32 @@ def main():
             proc.kill()
             proc.communicate()  # reap
             state["stage_meta"].append({"attempt": "restart_warm",
+                                        "error": "timeout"})
+        finally:
+            state["child"] = None
+
+    # SHARD-SCALING pass: Q1/Q6 single-device vs row-sharded over the
+    # device mesh through the explicit SPMD executor.  The XLA_FLAGS
+    # default gives a CPU-only host its 8-virtual-device mesh; a real
+    # multi-chip host keeps its own devices.
+    scaling_left = deadline - EMIT_MARGIN - time.monotonic()
+    if scaling_left > 60:
+        env = dict(env_base, BENCH_SHARD_SCALING="1",
+                   BENCH_STAGE_QUERIES="1,6",
+                   BENCH_CHILD_DEADLINE=str(time.time() + scaling_left - 10))
+        env.setdefault("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=8")
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        state["child"] = proc
+        try:
+            proc.communicate(timeout=scaling_left)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()  # reap
+            state["stage_meta"].append({"attempt": "shard_scaling",
                                         "error": "timeout"})
         finally:
             state["child"] = None

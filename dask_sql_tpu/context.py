@@ -406,14 +406,26 @@ class Context:
                     _tel.annotate(plan_fp=fp)
             except Exception:
                 logger.debug("plan fingerprint failed", exc_info=True)
+        # SPMD multi-chip backend (parallel/spmd.py): with a device mesh
+        # attached, stages execute as explicit shard_map programs over
+        # row-sharded tables.  None means the plan is outside the SPMD
+        # envelope or a runtime safety flag tripped — the single-device
+        # tiers below serve it instead.
+        result = None
+        span = _tel.current_span()
+        if self.mesh is not None:
+            from .parallel.spmd import try_execute_spmd
+            result = try_execute_spmd(plan, self)
+            if result is not None and span is not None:
+                span.attrs.setdefault("tier", "spmd")
         # whole-plan jit (one device dispatch per query); falls back to
         # the eager per-op executor for plan shapes outside its subset
-        from .physical.compiled import try_execute_compiled
-        result = try_execute_compiled(plan, self)
+        if result is None:
+            from .physical.compiled import try_execute_compiled
+            result = try_execute_compiled(plan, self)
         # execution-tier annotation (tiered execution, physical/compiled):
         # "compiled", "eager", or the gate's own "eager-compiling" — the
         # gate's verdict wins, so only fill in when it said nothing
-        span = _tel.current_span()
         if result is None:
             if span is not None:
                 span.attrs.setdefault("tier", "eager")

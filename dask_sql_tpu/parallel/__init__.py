@@ -1,0 +1,23 @@
+"""Multi-chip execution over the device mesh.
+
+- ``mesh``        — mesh construction + row-sharded table placement with
+                    validity-mask padding (``shard_table_with_validity``)
+- ``exchange``    — in-trace hash-partition ``all_to_all`` exchange and
+                    broadcast (``all_gather``) join primitives
+- ``partial_agg`` — per-shard partial aggregates + ``psum``/``all_gather``
+                    combine trees
+- ``spmd``        — the stage-level SPMD executor: whole query stages as
+                    explicit ``shard_map`` programs (``try_execute_spmd``)
+- ``distributed`` — standalone shard_map collective kernels (each wrapped
+                    in its own program; the SPMD executor uses the
+                    un-wrapped bodies from exchange/partial_agg instead)
+"""
+from .mesh import (ROW_AXIS, default_mesh, replicated, row_sharding,
+                   shard_table, shard_table_with_validity)
+from .spmd import spmd_enabled, try_execute_spmd
+
+__all__ = [
+    "ROW_AXIS", "default_mesh", "replicated", "row_sharding",
+    "shard_table", "shard_table_with_validity",
+    "spmd_enabled", "try_execute_spmd",
+]

@@ -1,0 +1,135 @@
+"""In-trace hash-partition exchange + broadcast primitives for SPMD stages.
+
+``distributed.py`` wraps each collective kernel in its OWN ``shard_map`` —
+right for standalone use, wrong for a stage program, where the whole stage
+(scan -> filter -> join -> aggregate) must trace as ONE program so XLA fuses
+across the collectives.  The functions here are the un-wrapped bodies: they
+run INSIDE an enclosing ``shard_map`` trace (physical/spmd lowering,
+parallel/spmd.py), operate on per-device LOCAL shards, and call ``jax.lax``
+collectives directly against the row axis.
+
+Conventions shared with the SPMD lowering:
+
+- Partition codes are int64; ``-1`` marks a dead slot (row invalid / key
+  NULL for joins).  ``exchange`` routes row ``code % n_dev`` and pads every
+  destination bucket to the full local length, so no row is ever dropped.
+- Broadcast-side keys use ``BROADCAST_SENTINEL`` for dead rows instead
+  (sorts last, never matches a live probe).
+- Flags (duplicate build keys, radix overflow, slot-cap overflow) are
+  returned as traced bools; device-local observations must pass through
+  ``replicated_flag`` before leaving the shard_map body.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import ROW_AXIS
+
+#: Dead-slot key for the broadcast (all_gather) join path: larger than any
+#: real key the engine produces (keys are table values or dictionary codes),
+#: sorts after every live key, and never equals a live probe key.  A live
+#: key colliding with it is flagged by the lowering, not silently dropped.
+BROADCAST_SENTINEL = (1 << 62)
+
+
+def replicated_flag(flag: jax.Array, axis: str = ROW_AXIS) -> jax.Array:
+    """Combine a device-local bool observation into a replicated bool.
+
+    Everything leaving a shard_map body with a replicated out-spec must
+    actually BE replicated; pmax is the cheapest any() across the mesh.
+    """
+    return jax.lax.pmax(flag.astype(jnp.int32), axis) > 0
+
+
+def shard_replicated(r: jax.Array, n_dev: int, axis: str = ROW_AXIS
+                     ) -> Tuple[jax.Array, int]:
+    """Emit a replicated per-device array through a P(ROW_AXIS) out-spec.
+
+    Stage programs use ONE uniform row-sharded out-spec for every output
+    (specs must be known before tracing; the output arity is not).  A
+    replicated value of length k pads to ``ceil(k/n) * n`` and each device
+    emits its own slice — the reassembled global array carries the value
+    once.  Returns (local slice, padded global length); the host reads
+    ``global_out[:k]``.
+    """
+    k = int(r.shape[0])
+    per = max(1, -(-k // n_dev))
+    kp = per * n_dev
+    if kp != k:
+        pad = [(0, kp - k)] + [(0, 0)] * (r.ndim - 1)
+        r = jnp.pad(r, pad)
+    i = jax.lax.axis_index(axis)
+    return jax.lax.dynamic_slice_in_dim(r, i * per, per), kp
+
+
+def exchange(codes: jax.Array, payloads: Sequence[jax.Array], n_dev: int,
+             axis: str = ROW_AXIS) -> Tuple[jax.Array, List[jax.Array]]:
+    """Radix-partition local rows by ``code % n_dev`` and all_to_all them.
+
+    Static shapes: each device sends a full local-length bucket to every
+    destination (code -1 padding), so the output is ``[n_dev * local]`` per
+    device — a sparse but lossless redistribution where equal codes are
+    guaranteed co-resident.  Payload arrays ride the same permutation with
+    0-fill (their dead slots are identified via ``codes_out < 0``).
+    """
+    local = codes.shape[0]
+    dest = jnp.where(codes >= 0, codes % n_dev, 0).astype(jnp.int32)
+    order = jnp.argsort(dest, stable=True)
+    sorted_dest = dest[order]
+    start = jnp.searchsorted(sorted_dest, jnp.arange(n_dev))
+    pos = jnp.arange(local) - start[sorted_dest]
+
+    def scatter(x: jax.Array, fill) -> jax.Array:
+        buf = jnp.full((n_dev, local), fill, dtype=x.dtype)
+        return buf.at[sorted_dest, pos].set(x[order])
+
+    codes_out = jax.lax.all_to_all(
+        scatter(codes, -1), axis, 0, 0, tiled=False).reshape(-1)
+    payload_out = [
+        jax.lax.all_to_all(scatter(v, 0), axis, 0, 0,
+                           tiled=False).reshape(-1)
+        for v in payloads
+    ]
+    return codes_out, payload_out
+
+
+def exchange_bytes(codes: jax.Array, payloads: Sequence[jax.Array],
+                   n_dev: int) -> int:
+    """Static estimate of bytes moved by one ``exchange`` call, across all
+    devices (send-buffer volume; shapes are static at trace time)."""
+    total = 0
+    for a in (codes, *payloads):
+        total += int(a.size) * a.dtype.itemsize * n_dev * n_dev
+    return total
+
+
+def gather_build(arr: jax.Array, axis: str = ROW_AXIS) -> jax.Array:
+    """all_gather a (small) build-side array: the replicate half of the
+    broadcast join.  tiled=True concatenates shards along rows."""
+    return jax.lax.all_gather(arr, axis, tiled=True)
+
+
+def sorted_probe(build_keys: jax.Array, probe_keys: jax.Array,
+                 sentinel: int = BROADCAST_SENTINEL
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Probe ``probe_keys`` against ``build_keys`` via sort + searchsorted.
+
+    Dead rows on either side carry ``sentinel``.  Returns
+    ``(idx, hit, dup)``: ``idx[i]`` indexes into build-row order for probe
+    row i (valid only where ``hit``), and ``dup`` is a traced bool set when
+    two LIVE build keys are equal — the single-match formulation is then
+    wrong (multi-match join) and the caller must raise its fallback flag.
+    """
+    order = jnp.argsort(build_keys, stable=True)
+    sk = build_keys[order]
+    hi = max(int(sk.shape[0]) - 1, 0)
+    pos = jnp.clip(jnp.searchsorted(sk, probe_keys), 0, hi)
+    hit = (sk[pos] == probe_keys) & (probe_keys != sentinel)
+    if sk.shape[0] > 1:
+        dup = jnp.any((sk[1:] == sk[:-1]) & (sk[1:] != sentinel))
+    else:
+        dup = jnp.zeros((), dtype=bool)
+    return order[pos], hit, dup

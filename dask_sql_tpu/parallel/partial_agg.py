@@ -1,0 +1,148 @@
+"""Per-shard partial aggregates + combine trees (in-trace, shard_map body).
+
+The partial-aggregate algebra ("Partial Partial Aggregates", PAPERS.md):
+every supported SQL aggregate decomposes into a per-shard PARTIAL that is
+local to one device plus an associative COMBINE over the mesh axis —
+``psum`` for SUM/COUNT (AVG = psum(sum)/psum(count)), ``pmin``/``pmax`` for
+MIN/MAX.  Grouped aggregation combines per-device group tables with
+``all_gather`` after a hash exchange has made group ownership disjoint
+(parallel/exchange.py), so the gathered slot tables need no cross-device
+merge at all.
+
+Like exchange.py these run INSIDE an enclosing ``shard_map`` trace on local
+shards; ``sharded=False`` callers (replicated interior tables) use the
+local-only halves and skip the collectives entirely — a psum over an
+already-replicated value would multiply by the device count.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import ROW_AXIS
+
+#: Local slot id for rows outside every group (dead rows / cap overflow):
+#: segment reductions use ``cap + 1`` segments and drop the trash slot.
+_TRASH = -1  # sentinel doc only; the trash slot is index ``cap``
+
+
+def _widen(data: jax.Array) -> jax.Array:
+    """Accumulator dtype: f64 for floats, i64 for ints/bools (matches the
+    single-device whole_table_aggregate so answers agree bit-for-pattern)."""
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        return data.astype(jnp.float64)
+    return data.astype(jnp.int64)
+
+
+def global_sum(data: jax.Array, ok: jax.Array, sharded: bool,
+               axis: str = ROW_AXIS) -> Tuple[jax.Array, jax.Array]:
+    """(sum, valid_count) over all live rows, combined across the mesh."""
+    s = jnp.sum(jnp.where(ok, _widen(data), 0))
+    c = jnp.sum(ok.astype(jnp.int64))
+    if sharded:
+        s = jax.lax.psum(s, axis)
+        c = jax.lax.psum(c, axis)
+    return s, c
+
+
+def global_count(ok: jax.Array, sharded: bool,
+                 axis: str = ROW_AXIS) -> jax.Array:
+    c = jnp.sum(ok.astype(jnp.int64))
+    return jax.lax.psum(c, axis) if sharded else c
+
+
+def minmax_sentinel(data: jax.Array, is_min: bool):
+    """The identity element masking dead rows out of a min/max reduction."""
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        return jnp.inf if is_min else -jnp.inf
+    if data.dtype == jnp.bool_:
+        return True if is_min else False
+    info = jnp.iinfo(data.dtype)
+    return info.max if is_min else info.min
+
+
+def global_minmax(data: jax.Array, ok: jax.Array, is_min: bool, sharded: bool,
+                  axis: str = ROW_AXIS) -> jax.Array:
+    sent = minmax_sentinel(data, is_min)
+    work = jnp.where(ok, data, sent)
+    if work.dtype == jnp.bool_:
+        work = work.astype(jnp.int32)
+    local = jnp.min(work) if is_min else jnp.max(work)
+    if sharded:
+        op = jax.lax.pmin if is_min else jax.lax.pmax
+        local = op(local, axis)
+    return local
+
+
+# ---------------------------------------------------------------------------
+# grouped partials: local slot tables after the hash exchange
+# ---------------------------------------------------------------------------
+
+def local_slots(codes: jax.Array, cap: int
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Assign each local row a dense group slot in ``[0, cap)``.
+
+    ``codes`` are int64 group codes with -1 for dead rows.  Post-exchange
+    every group lives wholly on this device, so local slotting IS global
+    slotting for the keys this device owns.  Returns ``(slot, slot_codes,
+    overflow)``: ``slot[i]`` in ``[0, cap]`` (``cap`` = trash for dead rows
+    and groups beyond the cap), ``slot_codes[g]`` the group's code (-1 for
+    empty slots), and ``overflow`` a LOCAL traced bool set when more than
+    ``cap`` distinct groups appeared (answers would be silently wrong —
+    the caller must replicate it into a fallback flag).
+    """
+    n = codes.shape[0]
+    big = jnp.int64(1 << 62)
+    skey = jnp.where(codes >= 0, codes, big)
+    order = jnp.argsort(skey)
+    sc = skey[order]
+    live = sc < big
+    first = jnp.concatenate([live[:1], (sc[1:] != sc[:-1]) & live[1:]])
+    rank = jnp.cumsum(first.astype(jnp.int64)) - 1
+    overflow = jnp.any(live & (rank >= cap))
+    slot_sorted = jnp.where(live & (rank < cap), rank, cap).astype(jnp.int32)
+    slot = jnp.full((n,), cap, dtype=jnp.int32).at[order].set(slot_sorted)
+    buf = jnp.full((cap + 1,), -1, dtype=jnp.int64)
+    buf = buf.at[slot_sorted].set(jnp.where(live, sc, -1))
+    return slot, buf[:cap], overflow
+
+
+def slot_sum(data: jax.Array, ok: jax.Array, slot: jax.Array, cap: int
+             ) -> Tuple[jax.Array, jax.Array]:
+    """(per-slot sum, per-slot valid count) — the grouped partial for
+    SUM/AVG/COUNT(col).  Dead rows ride to the trash slot and fall off."""
+    work = jnp.where(ok, _widen(data), 0)
+    s = jax.ops.segment_sum(work, slot, cap + 1)[:cap]
+    c = jax.ops.segment_sum(ok.astype(jnp.int64), slot, cap + 1)[:cap]
+    return s, c
+
+
+def slot_count(ok: jax.Array, slot: jax.Array, cap: int) -> jax.Array:
+    return jax.ops.segment_sum(ok.astype(jnp.int64), slot, cap + 1)[:cap]
+
+
+def slot_minmax(data: jax.Array, ok: jax.Array, slot: jax.Array, cap: int,
+                is_min: bool) -> jax.Array:
+    sent = minmax_sentinel(data, is_min)
+    work = jnp.where(ok, data, sent)
+    if work.dtype == jnp.bool_:
+        work = work.astype(jnp.int32)
+    f = jax.ops.segment_min if is_min else jax.ops.segment_max
+    return f(work, slot, cap + 1)[:cap]
+
+
+def gather_groups(arr: jax.Array, sharded: bool,
+                  axis: str = ROW_AXIS) -> jax.Array:
+    """Combine disjoint per-device slot tables into the replicated global
+    group table: a plain all_gather — ownership is disjoint post-exchange,
+    so concatenation IS the merge."""
+    return jax.lax.all_gather(arr, axis, tiled=True) if sharded else arr
+
+
+def psum_table(arr: jax.Array, sharded: bool,
+               axis: str = ROW_AXIS) -> jax.Array:
+    """Combine OVERLAPPING per-device partials (static-domain path, where
+    every device aggregates into the same dense slot table): a psum tree."""
+    return jax.lax.psum(arr, axis) if sharded else arr

@@ -296,6 +296,18 @@ def _fp_inputs(scans: list) -> tuple:
     return tuple(out)
 
 
+def _mesh_signature(context) -> str:
+    """Sharding layout component of program identity: tracing under a
+    device mesh lets GSPMD bake in a different partitioning, so a program
+    (or persisted executable) compiled with a mesh must never be served to
+    a mesh-less context or a different mesh shape — and vice versa."""
+    mesh = getattr(context, "mesh", None)
+    if mesh is None:
+        return ""
+    return "x".join(f"{n}:{s}"
+                    for n, s in zip(mesh.axis_names, mesh.devices.shape))
+
+
 # ---------------------------------------------------------------------------
 # in-trace kernels
 # ---------------------------------------------------------------------------
@@ -1996,13 +2008,13 @@ _BOUNDARY_NAME_RE = re.compile(r"__split__\.t[0-9a-f]{16}")
 
 
 def _canonical_program_key(base_key):
-    plan_fp, inputs_fp, on_tpu = base_key
+    plan_fp = base_key[0]
     mapping: Dict[str, str] = {}
 
     def sub(m):
         return mapping.setdefault(m.group(0), f"__split__.#{len(mapping)}")
 
-    return (_BOUNDARY_NAME_RE.sub(sub, plan_fp), inputs_fp, on_tpu)
+    return (_BOUNDARY_NAME_RE.sub(sub, plan_fp),) + tuple(base_key[1:])
 
 
 def _pstore_digest(base_key) -> str:
@@ -2411,7 +2423,7 @@ def _scan_uids(rel: RelNode, context) -> list:
     they live in rex trees, not inputs, and their scans must contribute or
     the data-mutation race the stage digest closes reopens)."""
     if isinstance(rel, LogicalTableScan):
-        if rel.schema_name == _SPLIT_SCHEMA:
+        if rel.schema_name in (_SPLIT_SCHEMA, "__spmd__"):
             # a boundary scan's NAME is already a content digest of its
             # producing subtree (scan uids folded in transitively) — and the
             # temp table may not be registered yet at partition time
@@ -2867,7 +2879,8 @@ def _probe_single(plan: RelNode, context, on_tpu: bool) -> bool:
         fp = _fp_plan(plan, context, scans)
     except Unsupported:
         return True  # needs no compile; the normal path serves it eager
-    return _program_decided((fp, _fp_inputs(scans), on_tpu), scans)
+    return _program_decided((fp, _fp_inputs(scans), on_tpu,
+                             _mesh_signature(context)), scans)
 
 
 def _programs_ready(plan: RelNode, context, base_key, budget: int) -> bool:
@@ -2982,7 +2995,8 @@ def tier_probe(plan: RelNode, context) -> str:
         plan_fp = _fp_plan(plan, context, scans)
     except Unsupported:
         return "eager"
-    base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()))
+    base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()),
+                    _mesh_signature(context))
     hint = _learned_caps_get(base_key).get("__split__")
     budget = stage_budget(int(hint) if hint is not None else None)
     try:
@@ -3021,7 +3035,8 @@ def try_execute_compiled(plan: RelNode, context,
         logger.debug("not compilable: %s", e)
         _tel.inc("unsupported")
         return None
-    base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()))
+    base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()),
+                    _mesh_signature(context))
 
     budget_override = _split_limit
     heavy = _heavy_count(plan)
@@ -3067,7 +3082,8 @@ def _execute_single(plan: RelNode, context, query_fp: str,
         logger.debug("not compilable: %s", e)
         _tel.inc("unsupported")
         return None
-    base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()))
+    base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()),
+                    _mesh_signature(context))
 
     host_sort = None
     if not _on_tpu() and isinstance(plan, LogicalSort):
@@ -3091,7 +3107,8 @@ def _execute_single(plan: RelNode, context, query_fp: str,
         # strategies (merge vs gather join), and with content-based input
         # fingerprints a program — or an _UNSUPPORTED verdict — traced for
         # one backend could otherwise replay on another
-        base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()))
+        base_key = (plan_fp, _fp_inputs(scans), bool(_on_tpu()),
+                    _mesh_signature(context))
     # runtime verdicts (non-unique build keys, hash collisions) depend on
     # NUMERIC data the layout fingerprint cannot see, so they are pinned to
     # the exact Tables via uid — a reload with corrected data must get a

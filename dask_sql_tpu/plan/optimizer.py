@@ -926,6 +926,32 @@ def split_join_condition(rel: LogicalJoin):
     return equi, residual
 
 
+def peel_root_epilogue(plan: RelNode):
+    """Split ``plan`` into (core, epilogue): the epilogue is the root
+    Project/Sort chain down to and including its DEEPEST Sort, returned in
+    application order (deepest first); Projects below that Sort stay in the
+    core.  No terminal Sort means no epilogue.
+
+    The SPMD backend (parallel/spmd.py) executes the core sharded and
+    applies the epilogue on the host over the compacted result — a global
+    ORDER BY inside a shard_map program would be a full repartition for
+    rows the host materializes anyway (the same reasoning as the compiled
+    executor's off-TPU host_sort peel)."""
+    chain: List[RelNode] = []
+    node = plan
+    while isinstance(node, (LogicalProject, LogicalSort)):
+        chain.append(node)
+        node = node.inputs[0]
+    last_sort = None
+    for i, nd in enumerate(chain):
+        if isinstance(nd, LogicalSort):
+            last_sort = i
+    if last_sort is None:
+        return plan, []
+    peeled = chain[:last_sort + 1]
+    return peeled[-1].inputs[0], list(reversed(peeled))
+
+
 _EXIST_TEST_OPS = {"<>", "<", "<=", ">", ">="}
 _EXIST_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "<>": "<>"}
 

@@ -89,7 +89,14 @@ def runtime_fingerprint() -> Dict[str, str]:
         jaxlib_v = getattr(jaxlib, "__version__", "?")
     except Exception:  # pragma: no cover
         jaxlib_v = "?"
-    return {"device": _quar.device_fingerprint(),
+    try:
+        import jax
+        # executables are device-LAYOUT specific too: an SPMD program
+        # compiled for an 8-device mesh cannot load on a 1-device process
+        n_dev = str(jax.device_count())
+    except Exception:  # pragma: no cover
+        n_dev = "?"
+    return {"device": _quar.device_fingerprint(), "devices": n_dev,
             "jax": jax_v, "jaxlib": jaxlib_v, "format": str(_FORMAT_VERSION)}
 
 

@@ -28,7 +28,7 @@ import numpy as np
 from ..table import Table
 
 TABLE_NAMES = ("queries", "active", "metrics", "cache", "quarantine",
-               "programs", "table_stats")
+               "programs", "table_stats", "mesh")
 
 
 def _col(rows: List[dict], key: str, dtype, default):
@@ -198,6 +198,53 @@ def _table_stats(context=None) -> Table:
     })
 
 
+def _mesh(context=None) -> Table:
+    """One row per visible device, with the context's mesh placement and
+    whether the SPMD backend would serve queries on it (parallel/spmd.py
+    spmd_enabled: a >=2-device mesh attached and DSQL_MESH != 0)."""
+    import jax
+
+    mesh = getattr(context, "mesh", None) if context is not None else None
+    axis = ""
+    mesh_size = 0
+    enabled = False
+    if mesh is not None:
+        axis = "x".join(f"{n}:{s}" for n, s in
+                        zip(mesh.axis_names, mesh.devices.shape))
+        mesh_size = int(mesh.devices.size)
+        mesh_ids = {d.id for d in mesh.devices.flat}
+        from ..parallel.spmd import spmd_enabled
+        enabled = spmd_enabled(context)
+    else:
+        mesh_ids = set()
+    rows = []
+    try:
+        devices = jax.devices()
+    except Exception:  # pragma: no cover
+        devices = []
+    for d in devices:
+        rows.append({
+            "device_id": int(d.id),
+            "platform": str(getattr(d, "platform", "")),
+            "kind": str(getattr(d, "device_kind", "")),
+            "process": int(getattr(d, "process_index", 0)),
+            "in_mesh": d.id in mesh_ids,
+            "mesh_axes": axis,
+            "mesh_size": mesh_size,
+            "spmd_enabled": enabled,
+        })
+    return Table.from_pydict({
+        "device_id": _col(rows, "device_id", np.int64, 0),
+        "platform": _col(rows, "platform", object, ""),
+        "kind": _col(rows, "kind", object, ""),
+        "process": _col(rows, "process", np.int64, 0),
+        "in_mesh": _col(rows, "in_mesh", np.bool_, False),
+        "mesh_axes": _col(rows, "mesh_axes", object, ""),
+        "mesh_size": _col(rows, "mesh_size", np.int64, 0),
+        "spmd_enabled": _col(rows, "spmd_enabled", np.bool_, False),
+    })
+
+
 _BUILDERS: Dict[str, object] = {
     "queries": _queries,
     "active": _active,
@@ -206,7 +253,11 @@ _BUILDERS: Dict[str, object] = {
     "quarantine": _quarantine,
     "programs": _programs,
     "table_stats": _table_stats,
+    "mesh": _mesh,
 }
+
+#: builders that need the resolving context (catalog / mesh live there)
+_CONTEXT_BUILDERS = (_table_stats, _mesh)
 
 
 def build(name: str, context=None) -> Optional[Table]:
@@ -215,6 +266,6 @@ def build(name: str, context=None) -> Optional[Table]:
     builder = _BUILDERS.get(name.lower())
     if builder is None:
         return None
-    if builder is _table_stats:
-        return _table_stats(context)
+    if builder in _CONTEXT_BUILDERS:
+        return builder(context)  # type: ignore[operator]
     return builder()  # type: ignore[operator]

@@ -115,6 +115,15 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     # scan-bytes×multiplier heuristic (scheduler.estimate_working_set)
     "history_records", "history_truncations", "history_errors",
     "estimate_from_history",
+    # SPMD multi-chip backend (parallel/spmd.py): queries/stages served
+    # sharded, program compiles vs cross-process store hits, collective
+    # traffic (hash-exchange rounds + bytes moved, partial-aggregate
+    # trees, broadcast-vs-exchange join dispatch), and the two refusal
+    # paths — static gate (unsupported) vs runtime safety flag (fallback)
+    "spmd_queries", "spmd_stages", "spmd_compiles", "spmd_store_hits",
+    "spmd_exchanges", "spmd_exchange_bytes", "spmd_partial_aggs",
+    "spmd_broadcast_joins", "spmd_exchange_joins", "spmd_join_flips",
+    "spmd_fallbacks", "spmd_unsupported",
 )
 
 STABLE_HISTOGRAMS: Tuple[str, ...] = (

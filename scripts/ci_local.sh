@@ -70,9 +70,18 @@ echo "=== [2h] stats smoke (adaptive operator selection) ==="
 # attach the fact table last, and DSQL_ADAPTIVE=0 must restore baseline
 python scripts/stats_smoke.py
 
+echo "=== [2i] shard smoke (explicit SPMD multi-chip executor) ==="
+# Q1/Q3/Q6 sharded over the 8-device mesh must match the single-device
+# answers with the spmd_* counters proving the sharded path served them
+# (exchange/partial-agg collectives, nonzero exchange bytes on Q3), a
+# zero broadcast cap must force the hash-partition exchange join, and
+# DSQL_MESH=0 must restore the baseline with no spmd counters moving
+python scripts/shard_smoke.py
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \
+                 tests/integration/test_spmd_executor.py \
                  tests/integration/test_multihost.py -q
 
 echo "=== [4/4] bare install smoke ==="

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Multi-chip SPMD smoke gate (parallel/spmd.py).
+
+Run by scripts/ci_local.sh (mirroring cache_smoke.py / stats_smoke.py):
+
+    python scripts/shard_smoke.py
+
+Asserts, on the 8-virtual-device CPU mesh against generated TPC-H data:
+
+  1. **Q1 and Q6 run sharded and agree with the single-device engine**:
+     the ``spmd_queries`` counter must advance (a silent fallback to the
+     single-device path would still be correct — counters are the honest
+     signal) with zero ``spmd_fallbacks``;
+  2. **Q3 moves rows**: the 3-table join + group-by must fire exchange
+     and/or broadcast join collectives, with ``spmd_exchange_bytes``
+     accounting for the traffic;
+  3. a **forced hash-partition exchange** (DSQL_SPMD_BROADCAST_ROWS=0)
+     still produces the single-device answer;
+  4. **DSQL_MESH=0 restores the baseline**: same answers, no spmd
+     counters moving.
+
+Exit 0 on success — if the sharded lowering drifts from the single-device
+semantics, or the kill switch stops killing, this gate fails loudly.
+"""
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["DSQL_TIERED"] = "0"
+os.environ["DSQL_RESULT_CACHE_MB"] = "0"
+os.environ["DSQL_MAX_CONCURRENT_QUERIES"] = "0"
+os.environ.pop("DSQL_MESH", None)
+os.environ.pop("DSQL_SPMD_BROADCAST_ROWS", None)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+
+from benchmarks.tpch import QUERIES, generate_tpch  # noqa: E402
+from dask_sql_tpu import Context  # noqa: E402
+from dask_sql_tpu.parallel.mesh import default_mesh  # noqa: E402
+from dask_sql_tpu.runtime import telemetry as tel  # noqa: E402
+
+
+def spmd_counters():
+    snap = tel.REGISTRY.counters()
+    return {k: int(v) for k, v in snap.items() if k.startswith("spmd_")}
+
+
+def check_frames(qid, got, want, note=""):
+    assert len(got) == len(want), \
+        f"Q{qid}{note}: {len(got)} vs {len(want)} rows"
+    for gc, wc in zip(got.columns, want.columns):
+        g, w = got[gc].to_numpy(), want[wc].to_numpy()
+        if g.dtype.kind == "f":
+            assert np.allclose(g.astype(float), w.astype(float),
+                               rtol=1e-9, equal_nan=True), \
+                f"Q{qid}{note} col {gc}"
+        else:
+            assert (pd.Series(g).astype(str).to_numpy()
+                    == pd.Series(w).astype(str).to_numpy()).all(), \
+                f"Q{qid}{note} col {gc}"
+
+
+def main():
+    mesh = default_mesh()
+    n_dev = int(mesh.devices.size)
+    assert n_dev >= 2, f"smoke needs a multi-device mesh, got {n_dev}"
+
+    data = generate_tpch(0.002, seed=3)
+    plain = Context()
+    dist = Context(mesh=mesh)
+    for name, frame in data.items():
+        plain.create_table(name, frame)
+        dist.create_table(name, frame)
+
+    refs = {qid: plain.sql(QUERIES[qid], return_futures=False)
+            for qid in (1, 3, 6)}
+
+    # 1+2: sharded Q1/Q3/Q6 match the single-device answers, with the
+    # counters proving the sharded path (not a fallback) served them
+    for qid in (1, 3, 6):
+        before = spmd_counters()
+        got = dist.sql(QUERIES[qid], return_futures=False)
+        d = {k: v - before.get(k, 0) for k, v in spmd_counters().items()}
+        assert d.get("spmd_queries", 0) == 1, f"Q{qid} not sharded: {d}"
+        assert d.get("spmd_fallbacks", 0) == 0, f"Q{qid} fell back: {d}"
+        assert d.get("spmd_partial_aggs", 0) >= 1, f"Q{qid}: {d}"
+        if qid == 3:
+            joins = (d.get("spmd_broadcast_joins", 0)
+                     + d.get("spmd_exchange_joins", 0))
+            assert joins >= 1, f"Q3 ran without a join collective: {d}"
+            assert (d.get("spmd_exchanges", 0) == 0
+                    or d.get("spmd_exchange_bytes", 0) > 0), \
+                f"Q3 exchanged rows without byte accounting: {d}"
+        check_frames(qid, got, refs[qid])
+        print(f"  Q{qid} sharded over {n_dev} devices: match "
+              f"({ {k: v for k, v in d.items() if v} })")
+
+    # 3: a zero broadcast cap forces the hash-partitioned all_to_all
+    # exchange variant on Q3's joins — same answer, exchange counters up
+    os.environ["DSQL_SPMD_BROADCAST_ROWS"] = "0"
+    try:
+        before = spmd_counters()
+        got = dist.sql(QUERIES[3], return_futures=False)
+        d = {k: v - before.get(k, 0) for k, v in spmd_counters().items()}
+        if d.get("spmd_queries", 0) == 1:
+            assert d.get("spmd_exchange_joins", 0) >= 1, \
+                f"broadcast cap 0 did not force the exchange join: {d}"
+            assert d.get("spmd_exchange_bytes", 0) > 0, d
+            check_frames(3, got, refs[3], note=" (forced exchange)")
+            print(f"  Q3 forced-exchange: match "
+                  f"({d.get('spmd_exchange_bytes', 0)} bytes moved)")
+        else:
+            # the exchange variant may legitimately refuse shapes the
+            # broadcast variant accepts (e.g. a replicated build side);
+            # the answer must still be right via the fallback
+            check_frames(3, got, refs[3], note=" (forced exchange)")
+            print("  Q3 forced-exchange: fell back (answer still correct)")
+    finally:
+        os.environ.pop("DSQL_SPMD_BROADCAST_ROWS", None)
+
+    # 4: the kill switch restores the baseline path exactly
+    os.environ["DSQL_MESH"] = "0"
+    try:
+        before = spmd_counters()
+        for qid in (1, 6):
+            got = dist.sql(QUERIES[qid], return_futures=False)
+            check_frames(qid, got, refs[qid], note=" (DSQL_MESH=0)")
+        d = {k: v - before.get(k, 0) for k, v in spmd_counters().items()
+             if v != before.get(k, 0)}
+        assert not d, f"DSQL_MESH=0 but spmd counters moved: {d}"
+        print("  DSQL_MESH=0: baseline restored, no spmd counters")
+    finally:
+        os.environ.pop("DSQL_MESH", None)
+
+    print("shard smoke OK")
+
+
+if __name__ == "__main__":
+    main()

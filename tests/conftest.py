@@ -165,6 +165,22 @@ def _adaptive_off(request, monkeypatch):
     yield
 
 
+@pytest.fixture(autouse=True)
+def _mesh_off(request, monkeypatch):
+    """The SPMD multi-chip backend (parallel/spmd.py, on by default when a
+    context carries a mesh) intercepts mesh-context queries before the
+    compiled path — which would break every pre-existing mesh suite's
+    compiled-stats/fallback assertions (test_tpch_mesh asserts the GSPMD
+    whole-program path).  Mirroring the adaptive/history pins: non-SPMD
+    suites run with the DSQL_MESH=0 kill-switch pinned, the dedicated
+    spmd/shard suites arm it explicitly, and scripts/shard_smoke.py plus
+    __graft_entry__.dryrun_multichip gate the production-default path."""
+    name = request.module.__name__
+    if "spmd" not in name and "shard" not in name:
+        monkeypatch.setenv("DSQL_MESH", "0")
+    yield
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bounded_executable_lifetime():
     yield

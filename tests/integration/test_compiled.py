@@ -500,7 +500,7 @@ def test_learned_split_hint(monkeypatch, tmp_path):
     from dask_sql_tpu.ops.pallas_kernels import _strategy_on_tpu
     scans = []
     key = (cm._fp_plan(plan, c, scans), cm._fp_inputs(scans),
-           bool(_strategy_on_tpu()))
+           bool(_strategy_on_tpu()), cm._mesh_signature(c))
     cm._learned_caps_put(key, {"__split__": 1})
 
     got2 = c.sql(QUERIES[3], return_futures=False)
