@@ -173,29 +173,70 @@ class ChunkedSource:
         return source
 
     def _rebatch(self) -> None:
-        """Normalize to fixed-size batches after concatenating pieces."""
+        """Normalize to fixed-size batches after concatenating pieces.
+
+        Incremental: source pieces stream through a per-column carry
+        buffer and are RELEASED as they are consumed, so the transient
+        footprint is bounded by one output batch plus one input piece —
+        the table is never materialized as full contiguous host arrays
+        (which would defeat out-of-core parquet ingestion at exactly the
+        table sizes chunking exists for)."""
         if all(len(b[0][0]) == self.batch_rows for b in self.batches[:-1]):
             return
         cols = len(self.names)
-        full_cols = []
-        for ci in range(cols):
-            data = np.concatenate([b[ci][0] for b in self.batches])
-            masks = [b[ci][1] for b in self.batches]
-            if any(m is not None for m in masks):
-                mask = np.concatenate(
-                    [m if m is not None else np.ones(len(b[ci][0]), bool)
-                     for m, b in zip(masks, self.batches)])
-            else:
-                mask = None
-            full_cols.append((data, mask))
-        self.batches = []
-        for s0 in range(0, max(self.n_rows, 1), self.batch_rows):
+        has_mask = [any(b[ci][1] is not None for b in self.batches)
+                    for ci in range(cols)]
+        dtypes = [self.batches[0][ci][0].dtype for ci in range(cols)]
+        out: List[list] = []
+        pending: List[list] = [[] for _ in range(cols)]  # (data, mask)
+        pending_rows = 0
+
+        def emit(k: int) -> None:
+            nonlocal pending_rows
             enc = []
-            for data, mask in full_cols:
-                enc.append((data[s0:s0 + self.batch_rows],
-                            None if mask is None
-                            else mask[s0:s0 + self.batch_rows]))
-            self.batches.append(enc)
+            for ci in range(cols):
+                frags = pending[ci]
+                datas, masks, got = [], [], 0
+                while got < k:
+                    data, mask = frags[0]
+                    take = min(k - got, len(data))
+                    datas.append(data[:take])
+                    if has_mask[ci]:
+                        masks.append(mask[:take] if mask is not None
+                                     else np.ones(take, dtype=bool))
+                    if take == len(data):
+                        frags.pop(0)
+                    else:
+                        frags[0] = (data[take:],
+                                    None if mask is None else mask[take:])
+                    got += take
+                data = (datas[0] if len(datas) == 1
+                        else np.concatenate(datas))
+                mask = None
+                if has_mask[ci]:
+                    mask = (masks[0] if len(masks) == 1
+                            else np.concatenate(masks))
+                enc.append((data, mask))
+            pending_rows -= k
+            out.append(enc)
+
+        src = self.batches
+        for bi in range(len(src)):
+            piece = src[bi]
+            src[bi] = None  # release: the carry buffer bounds memory
+            n = len(piece[0][0]) if piece else 0
+            for ci in range(cols):
+                pending[ci].append(piece[ci])
+            pending_rows += n
+            while pending_rows >= self.batch_rows:
+                emit(self.batch_rows)
+        if pending_rows:
+            emit(pending_rows)
+        if not out:
+            # zero-row table: keep the one-empty-batch invariant
+            out.append([(np.zeros(0, dtype=dtypes[ci]), None)
+                        for ci in range(cols)])
+        self.batches = out
 
     # ----------------------------------------------------------- consuming
     @property

@@ -336,6 +336,14 @@ def _explain_analyze(plan, context):
     lines.append(tier_line)
     delta = {k: snap1[k] - snap0.get(k, 0) for k in snap1
              if snap1[k] != snap0.get(k, 0)}
+    # out-of-core marker: this run hash-partitioned inputs to spill tiers
+    # (grace join) — name the partition count and where the bytes went
+    if delta.get("spill_partitions"):
+        lines.append(
+            f"-- spilled: partitions=+{delta['spill_partitions']} "
+            f"pairs=+{delta.get('morsel_pairs', 0)} "
+            f"host_bytes=+{delta.get('spill_bytes_host', 0)} "
+            f"disk_bytes=+{delta.get('spill_bytes_disk', 0)}")
     if delta:
         lines.append("-- counters: " + " ".join(
             f"{k}=+{v}" for k, v in sorted(delta.items())))

@@ -32,7 +32,10 @@ and substitute it back.  Split strategies, tried innermost-first:
 Joins on a streamed path keep the build (resident) side fixed: subtrees
 not containing the chunked scan are materialized ONCE into temp tables and
 reused across batches.  Multiple chunked scans (e.g. TPC-H Q17/Q21 reading
-lineitem two or three times) lower one subtree per iteration.
+lineitem two or three times) lower one subtree per iteration.  An INNER
+equi-join with a chunked scan on BOTH sides — which no single-scan
+strategy covers — lowers via the grace-hash partitioned join in
+physical/morsel.py when spilling is enabled (DSQL_SPILL_MB > 0).
 
 Partial results accumulate on HOST (one batch resident on device at a
 time); when their total size exceeds ``DSQL_STREAM_PARTIAL_BYTES`` the
@@ -225,6 +228,15 @@ def _set_batch_entry(context, table: Table, row_valid) -> None:
 
 def _cleanup(context) -> None:
     context.schema.pop(STREAM_SCHEMA, None)
+    # grace-hash joins (physical/morsel.py) spill partition/output runs;
+    # free them even on the error path so a failed query leaks no bytes
+    runs = getattr(context, "_spill_runs", None)
+    if runs:
+        from ..runtime import spill as _spill
+        store = _spill.get_store()
+        for r in runs:
+            store.free_run(r)
+        runs.clear()
 
 
 def _stream_partial_plans(subtree: RelNode, scan: LogicalTableScan,
@@ -923,6 +935,13 @@ def _find_split(plan: RelNode, scan: LogicalTableScan, context):
             right_has = _path_to(node.right, scan) is not None
             if right_has and len(_chunked_scans(node.right, context)) == 1:
                 return "keyset", node, path
+        elif isinstance(node, LogicalJoin):
+            # TWO chunked sides: no single-scan strategy applies — the
+            # grace-hash partitioned join (physical/morsel.py) does,
+            # when spilling is enabled and an equi-key exists
+            from . import morsel as _morsel
+            if _morsel.grace_applicable(node, context):
+                return "grace", node, path
     raise StreamingUnsupported(
         "no aggregate or LIMIT above the chunked scan — the full result "
         "would be as large as the table; add a GROUP BY or LIMIT")
@@ -993,6 +1012,9 @@ def _lower_chunked(plan: RelNode, context) -> RelNode:
                 elif kind == "window":
                     old, new = _stream_window_split(node, scan, path,
                                                     source, context)
+                elif kind == "grace":
+                    from . import morsel as _morsel
+                    old, new = _morsel.grace_join_split(node, context)
                 else:
                     old, new = _stream_keyset_split(node, scan, source,
                                                     context)

@@ -155,13 +155,19 @@ def default_priority() -> str:
 
 def _entry_bytes(entry) -> int:
     """Resident bytes of one catalog entry; chunked (out-of-HBM) sources
-    estimate from their row count since only a binding stub is resident."""
+    estimate from their BATCH size, not their total row count — the
+    streaming executor keeps exactly one padded batch resident at a
+    time, so a chunked plan's device working set is O(batch_rows).
+    (Estimating from n_rows made every SF10 chunked query reserve the
+    whole budget and serialized the morsel pipelines the broker is
+    supposed to run concurrently.)"""
     chunked = getattr(entry, "chunked", None)
     table = getattr(entry, "table", None)
     if chunked is not None:
         n_rows = int(getattr(chunked, "n_rows", 0))
+        batch_rows = int(getattr(chunked, "batch_rows", 0)) or n_rows
         n_cols = len(getattr(table, "columns", ())) or 1
-        return n_rows * n_cols * 8
+        return min(n_rows, batch_rows) * n_cols * 8
     total = 0
     for c in getattr(table, "columns", ()):
         total += int(getattr(c.data, "nbytes", 0))
@@ -215,6 +221,17 @@ def estimate_working_set(plan, context) -> "Tuple[int, str]":
     if hist is not None:
         _tel.inc("estimate_from_history")
         return max(int(hist), _MIN_ESTIMATE), "history"
+    try:
+        from ..physical.streaming import plan_references_chunked
+        if plan_references_chunked(plan, context):
+            # chunked plans stream one batch at a time: the heuristic's
+            # scan bytes are already batch-bounded (_entry_bytes) and the
+            # operator multipliers stand in for live pipeline depth —
+            # journaled as its own source so admission decisions over
+            # out-of-core plans are auditable
+            return estimate_plan_bytes(plan, context), "chunked"
+    except Exception:    # estimator must never fail a query
+        logger.debug("chunked estimate failed", exc_info=True)
     est = _stats.estimate_plan_bytes_stats(plan, context)
     if est is not None:
         _tel.inc("estimate_from_stats")
@@ -248,6 +265,15 @@ class MemoryLedger:
         from . import result_cache as _rc
         return _rc.get_cache()
 
+    @staticmethod
+    def _spill():
+        """The spill store's device tier is the ledger's SECOND tenant
+        (after the result cache); absent/disabled stores count zero."""
+        from . import spill as _spill
+        if not _spill.enabled():
+            return None
+        return _spill.get_store()
+
     def budget(self) -> int:
         mb = _env_int("DSQL_DEVICE_BUDGET_MB", DEFAULT_DEVICE_BUDGET_MB)
         return max(mb, 0) * 2**20
@@ -268,13 +294,22 @@ class MemoryLedger:
         n = min(max(int(nbytes), 0), budget)
         with self._lock:
             cache = self._cache()
-            free = budget - self._reserved - int(cache.device_bytes)
+            spill = self._spill()
+            spill_dev = int(spill.device_bytes) if spill is not None else 0
+            free = (budget - self._reserved - int(cache.device_bytes)
+                    - spill_dev)
             if free < n:
                 # pressure-driven tenant shrink: spill/evict the cache's
-                # device tier down to what this reservation leaves over
+                # device tier down to what this reservation leaves over,
+                # then demote the spill store's device chunks to host
                 target = max(budget - self._reserved - n, 0)
                 cache.shrink_device_to(target)
-                free = budget - self._reserved - int(cache.device_bytes)
+                if spill is not None:
+                    spill.shrink_device_to(
+                        max(target - int(cache.device_bytes), 0))
+                    spill_dev = int(spill.device_bytes)
+                free = (budget - self._reserved - int(cache.device_bytes)
+                        - spill_dev)
             if free < n:
                 return None
             self._reserved += n
@@ -448,6 +483,19 @@ class WorkloadManager:
         budget = self.ledger.budget()
         if budget <= 0:
             return None
+        return max(budget - self.ledger.reserved_bytes(), 0)
+
+    def spill_allowance(self) -> int:
+        """Device bytes the spill store's device tier may hold right now
+        under ledger tenancy (runtime/spill.py put_table consults this
+        before pinning a join output on device).  Lock-free, like
+        cache_allowance; an unlimited broker answers a large sentinel so
+        the static DSQL_SPILL_DEVICE_MB cap still governs."""
+        if not self.enabled():
+            return 1 << 62
+        budget = self.ledger.budget()
+        if budget <= 0:
+            return 1 << 62
         return max(budget - self.ledger.reserved_bytes(), 0)
 
     # -- live introspection (server wire stats) -----------------------------

@@ -28,7 +28,7 @@ import numpy as np
 from ..table import Table
 
 TABLE_NAMES = ("queries", "active", "metrics", "cache", "quarantine",
-               "programs", "table_stats", "mesh")
+               "programs", "table_stats", "mesh", "spill")
 
 
 def _col(rows: List[dict], key: str, dtype, default):
@@ -245,6 +245,26 @@ def _mesh(context=None) -> Table:
     })
 
 
+def _spill() -> Table:
+    """One row per live spill run (grace-hash partition / out-of-core join
+    output), with its tier placement — a mid-query `SELECT * FROM
+    system.spill` from a second connection shows exactly which partitions
+    sit on device vs host vs disk.  Usually empty: runs are freed as each
+    partition pair completes."""
+    from . import spill as _spill_mod
+
+    rows = _spill_mod.get_store().runs_snapshot()
+    return Table.from_pydict({
+        "run": _col(rows, "run", object, ""),
+        "chunks": _col(rows, "chunks", np.int64, 0),
+        "rows": _col(rows, "rows", np.int64, 0),
+        "nbytes": _col(rows, "nbytes", np.int64, 0),
+        "device_chunks": _col(rows, "device_chunks", np.int64, 0),
+        "host_chunks": _col(rows, "host_chunks", np.int64, 0),
+        "disk_chunks": _col(rows, "disk_chunks", np.int64, 0),
+    })
+
+
 _BUILDERS: Dict[str, object] = {
     "queries": _queries,
     "active": _active,
@@ -254,6 +274,7 @@ _BUILDERS: Dict[str, object] = {
     "programs": _programs,
     "table_stats": _table_stats,
     "mesh": _mesh,
+    "spill": _spill,
 }
 
 #: builders that need the resolving context (catalog / mesh live there)

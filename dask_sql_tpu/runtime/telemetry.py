@@ -71,6 +71,7 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     "fault_compile", "fault_materialize", "fault_stage_exec",
     "fault_stage_replay", "fault_chunked_read", "fault_host_transfer",
     "fault_cache_populate", "fault_admission", "fault_drain",
+    "fault_spill",
     # failure-domain recovery (stage replay + quarantine + watchdog):
     # stage_execs counts stage-execution ATTEMPTS; stage_replays counts
     # checkpointed re-executions of a single failed stage;
@@ -104,6 +105,18 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     "result_cache_invalidations", "result_cache_subplan_hits",
     # streaming (out-of-HBM) execution
     "stream_batches", "stream_batch_rows",
+    # out-of-core spill store (runtime/spill.py): runs opened
+    # (spill_partitions — the EXPLAIN ANALYZE "spilled" signal), chunks
+    # written, tier movement (host->disk flushes, disk->host loads,
+    # device->host demotions), monotonic bytes written per tier, and
+    # typed spill-IO failures
+    "spill_partitions", "spill_chunks", "spill_flushes", "spill_loads",
+    "spill_demotions", "spill_bytes_host", "spill_bytes_disk",
+    "spill_errors",
+    # grace-hash morsel driver (physical/morsel.py): joins lowered to
+    # the partitioned path, partition pairs actually joined on device,
+    # and pairs whose padded capacity blew past the skew threshold
+    "morsel_joins", "morsel_pairs", "morsel_skew_warnings",
     # query lifecycle
     "queries", "query_errors", "slow_queries",
     # server boundary
@@ -140,6 +153,8 @@ STABLE_GAUGES: Tuple[str, ...] = (
     # 1 while the process is draining (SIGTERM/SIGINT received, in-flight
     # queries finishing, new admissions refused), else 0
     "server_draining",
+    # spill-store tier occupancy (runtime/spill.py), point-in-time
+    "spill_device_bytes", "spill_host_bytes", "spill_disk_bytes",
 )
 
 # exponential-ish bucket bounds in milliseconds; histograms are BOUNDED by
@@ -494,7 +509,7 @@ class QueryReport:
 
     __slots__ = ("query", "wall_ms", "phases", "counters", "root",
                  "rows_out", "bytes_out", "started_unix", "cache", "tier",
-                 "priority", "operators")
+                 "priority", "operators", "spilled")
 
     def __init__(self, trace: QueryTrace):
         root = trace.root
@@ -559,6 +574,12 @@ class QueryReport:
             if ops:
                 operators.extend(str(o) for o in ops)
         self.operators = operators
+        # out-of-core marker: the grace-hash driver annotates its morsel
+        # spans with spilled=True; the counter delta catches spills from
+        # nested plans that never opened a span under this trace
+        self.spilled = (self.counters.get("spill_partitions", 0) > 0
+                        or any(s.attrs.get("spilled")
+                               for s in root.walk()))
         self.cache = {"hit": hit, "tier": tier, "stored": stored,
                       "subplan_hits": subplan_hits,
                       "bytes": int(REGISTRY.get_gauge("result_cache_bytes")),
@@ -576,6 +597,7 @@ class QueryReport:
                 "tier": self.tier,
                 "priority": self.priority,
                 "operators": list(self.operators),
+                "spilled": self.spilled,
                 "rows_out": self.rows_out, "bytes_out": self.bytes_out,
                 "spans": self.root.to_dict()}
 
@@ -592,6 +614,8 @@ class QueryReport:
                 f"{k}=+{v}" for k, v in sorted(self.counters.items())))
         if self.operators:
             lines.append("operators: " + "; ".join(self.operators))
+        if self.spilled:
+            lines.append("spilled: true")
 
         def walk(s: Span, depth: int):
             attrs = "".join(f" {k}={v}" for k, v in sorted(s.attrs.items()))

@@ -74,6 +74,8 @@ ERROR_WIRE_MATRIX = {
                          "QUERY_QUEUE_TIMEOUT"),
     "ServerDraining": (503, "INSUFFICIENT_RESOURCES",
                        "SERVER_SHUTTING_DOWN"),
+    "SpillError": (200, "INTERNAL_ERROR", "SPILL_ERROR"),
+    "SpillCorrupt": (200, "INTERNAL_ERROR", "SPILL_CORRUPT"),
 }
 
 
@@ -280,6 +282,28 @@ def _data_payload(table) -> list:
 # GET /v1/engine: one live snapshot of the whole engine
 # ---------------------------------------------------------------------------
 
+def _spill_section(counters: dict) -> dict:
+    """Out-of-core occupancy for /v1/engine: store tiers (live bytes +
+    device peak) plus the cumulative partition/flush counters, so an
+    operator can tell a query is running out-of-core — and which tier is
+    absorbing it — without attaching a profiler."""
+    from ..runtime import spill as _spill
+
+    stats = _spill.get_store().stats()
+    return {
+        "enabled": stats["enabled"],
+        "runs": stats["runs"],
+        "chunks": stats["chunks"],
+        "deviceBytes": stats["device_bytes"],
+        "hostBytes": stats["host_bytes"],
+        "diskBytes": stats["disk_bytes"],
+        "peakDeviceBytes": stats["peak_device_bytes"],
+        "partitions": int(counters.get("spill_partitions", 0)),
+        "flushes": int(counters.get("spill_flushes", 0)),
+        "morselJoins": int(counters.get("morsel_joins", 0)),
+    }
+
+
 def _engine_snapshot(state: "_AppState") -> dict:
     """Everything an operator needs in one poll: in-flight queries with
     per-stage progress (flight recorder's live registry), scheduler queue
@@ -320,6 +344,7 @@ def _engine_snapshot(state: "_AppState") -> dict:
             "reservedBytes": mgr.ledger.reserved_bytes(),
         },
         "cache": _rc.get_cache().stats(),
+        "spill": _spill_section(counters),
         "quarantine": {
             "enabled": qstore.enabled(),
             "entries": len(qstore.entries()) if qstore.enabled() else 0,

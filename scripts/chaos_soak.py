@@ -51,6 +51,12 @@ os.environ.setdefault("DSQL_MAX_CONCURRENT_QUERIES", "2")
 os.environ.setdefault("DSQL_QUEUE_DEPTH", "64")
 os.environ.setdefault("DSQL_QUEUE_TIMEOUT_MS", "120000")
 os.environ.setdefault("DSQL_RETRY_BASE_MS", "1")
+# out-of-core on: the two-chunked join menu entry must route through the
+# grace-hash spill path so the ``spill`` + ``chunked_read`` fault sites
+# see real traffic (spill dir is per-run temp, cleaned by the OS)
+os.environ.setdefault("DSQL_SPILL_MB", "64")
+os.environ.setdefault("DSQL_SPILL_DIR",
+                      tempfile.mkdtemp(prefix="dsql_chaos_spill_"))
 # stage every multi-heavy plan so the stage-exec/stage-replay failure
 # domain is actually in play on the small soak queries
 os.environ.setdefault("DSQL_STAGE_HEAVY", "1")
@@ -109,6 +115,11 @@ def _menu(t1: pd.DataFrame, t2: pd.DataFrame):
                        "mx": [t1.w.max()]})),
         ("SELECT k, SUM(v) AS s FROM tc GROUP BY k",
          t1.groupby("k", as_index=False).agg(s=("v", "sum"))),
+        # two chunked sides: grace-hash partitioned join through the spill
+        # store (arms the ``spill`` site; partitions stream back per pair)
+        ("SELECT tc.k AS k, SUM(tc2.c) AS s FROM tc "
+         "JOIN tc2 ON tc.k = tc2.k GROUP BY tc.k",
+         j.groupby("k", as_index=False).agg(s=("c", "sum"))),
     ]
     for x in (2, 4, 6, 8):
         sql = (f"SELECT k, v FROM t1 WHERE v > {x}.0 "
@@ -154,8 +165,10 @@ def main(argv=None) -> int:
     ctx = Context()
     ctx.create_table("t1", t1)
     ctx.create_table("t2", t2)
-    # chunked registration exercises the streaming sites
+    # chunked registration exercises the streaming sites; the second
+    # chunked table forces the grace-hash join (spill sites) in the menu
     ctx.create_table("tc", t1, chunked=True, batch_rows=512)
+    ctx.create_table("tc2", t2, chunked=True, batch_rows=512)
     menu = _menu(t1, t2)
 
     c0 = tel.REGISTRY.counters()
@@ -259,6 +272,14 @@ def main(argv=None) -> int:
         except Exception as e:  # noqa: BLE001 - the gate records it
             failures.append(f"post-soak health check failed on {sql!r}: "
                             f"{type(e).__name__}: {str(e)[:200]}")
+
+    # spill hygiene: every grace run is freed on success AND error paths —
+    # a surviving run after all clients joined is a leak
+    from dask_sql_tpu.runtime import spill as spill_mod
+    sstats = spill_mod.get_store().stats()
+    if sstats["runs"]:
+        failures.append(f"spill store leaked {sstats['runs']} run(s) "
+                        "after the soak")
 
     interesting = ("retries", "degradations", "stage_replays",
                    "stage_replay_saved_stages", "stage_execs",

@@ -78,6 +78,13 @@ echo "=== [2i] shard smoke (explicit SPMD multi-chip executor) ==="
 # DSQL_MESH=0 must restore the baseline with no spmd counters moving
 python scripts/shard_smoke.py
 
+echo "=== [2j] out-of-core smoke (spill manager + grace-hash joins) ==="
+# TPC-H-shaped queries over chunked tables under a tiny device budget:
+# Q1/Q6 shapes stream, a Q3 shape grace-hash-partitions through the spill
+# store (spill_partitions > 0, runs freed, device occupancy bounded), and
+# DSQL_SPILL_MB=0 restores the pre-spill StreamingUnsupported baseline
+python scripts/ooc_smoke.py
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \

@@ -1,0 +1,212 @@
+"""Out-of-core morsel execution (physical/morsel.py + runtime/spill.py).
+
+The shape under test is the one physical/streaming.py refuses: a plan
+whose streamed path meets a SECOND chunked table.  The grace-hash join
+partitions both chunked sides on host into spill runs, joins partition
+pairs on device with the ordinary compiled join, and pipelines any
+GROUP BY above through the streaming combine algebra — so the whole
+query completes with the device holding one partition pair at a time.
+
+Every test checks against a pandas oracle and asserts spill hygiene:
+runs freed after the query, counters advanced only when the grace path
+actually ran.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu.physical.streaming import StreamingUnsupported
+from dask_sql_tpu.runtime import spill as spill_mod
+from dask_sql_tpu.runtime import telemetry as tel
+
+N_FACT = 20_000
+N_DIM = 6_000
+BATCH = 2_048  # 20000 % 2048 != 0: the short-final-batch path is always on
+
+
+def _norm(df: pd.DataFrame) -> pd.DataFrame:
+    out = df.copy()
+    for col in out.columns:
+        if out[col].dtype.kind in "iuf":
+            out[col] = out[col].astype("float64").round(6)
+    return (out.sort_values(list(out.columns), na_position="last")
+               .reset_index(drop=True))
+
+
+def _assert_frames(got, want):
+    pd.testing.assert_frame_equal(_norm(got), _norm(want),
+                                  check_dtype=False, rtol=1e-6, atol=1e-9)
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    key = rng.integers(0, N_DIM, N_FACT).astype("float64")
+    key[rng.random(N_FACT) < 0.03] = np.nan  # NULL join keys on the fact
+    fact = pd.DataFrame({
+        "fk": key,
+        "val": np.round(rng.random(N_FACT) * 100, 3),
+        "tag": rng.choice(["r", "g", "b"], N_FACT),
+    })
+    dim = pd.DataFrame({
+        "dk": np.arange(N_DIM),  # int64 vs the fact's float64 keys
+        "grp": rng.choice(["north", "south", "east", "west"], N_DIM),
+        "w": np.round(rng.random(N_DIM) * 10, 3),
+    })
+    return fact, dim
+
+
+@pytest.fixture
+def ooc_ctx(monkeypatch, tmp_path):
+    monkeypatch.setenv("DSQL_SPILL_MB", "64")
+    monkeypatch.setenv("DSQL_SPILL_DIR", str(tmp_path))
+    spill_mod.reset_store()
+    fact, dim = _data()
+    ctx = Context()
+    ctx.create_table("fact", fact, chunked=True, batch_rows=BATCH)
+    ctx.create_table("dim", dim, chunked=True, batch_rows=BATCH)
+    yield ctx, fact, dim
+    spill_mod.reset_store()
+
+
+def test_two_chunked_join_group_by(ooc_ctx):
+    ctx, fact, dim = ooc_ctx
+    c0 = tel.REGISTRY.counters()
+    got = ctx.sql(
+        "SELECT dim.grp AS grp, SUM(fact.val * dim.w) AS s, COUNT(*) AS n "
+        "FROM fact JOIN dim ON fact.fk = dim.dk GROUP BY dim.grp",
+        return_futures=False)
+    j = fact.merge(dim, left_on="fk", right_on="dk")  # NaN keys dropped
+    want = (j.assign(x=j.val * j.w)
+             .groupby("grp", as_index=False)
+             .agg(s=("x", "sum"), n=("x", "size")))
+    _assert_frames(got, want)
+    c1 = tel.REGISTRY.counters()
+    assert c1.get("morsel_joins", 0) > c0.get("morsel_joins", 0)
+    assert c1.get("spill_partitions", 0) > c0.get("spill_partitions", 0)
+    # hygiene: every grace run freed once the query materialized
+    stats = spill_mod.get_store().stats()
+    assert stats["runs"] == 0
+    assert stats["host_bytes"] == 0 and stats["disk_bytes"] == 0
+
+
+def test_join_without_group_by(ooc_ctx):
+    ctx, fact, dim = ooc_ctx
+    got = ctx.sql(
+        "SELECT fact.tag AS tag, dim.grp AS grp, fact.val AS val "
+        "FROM fact JOIN dim ON fact.fk = dim.dk WHERE dim.w > 9.0",
+        return_futures=False)
+    j = fact.merge(dim, left_on="fk", right_on="dk")
+    want = j[j.w > 9.0][["tag", "grp", "val"]]
+    _assert_frames(got, want)
+    assert spill_mod.get_store().stats()["runs"] == 0
+
+
+def test_string_equi_key(ooc_ctx, monkeypatch, tmp_path):
+    # string join keys hash by VALUE: the two tables' dictionaries differ
+    rng = np.random.default_rng(3)
+    left = pd.DataFrame({
+        "s": rng.choice(["aa", "bb", "cc", "dd"], 5000),
+        "v": rng.random(5000),
+    })
+    right = pd.DataFrame({
+        "s": rng.choice(["bb", "cc", "dd", "ee", "ff"], 3000),
+        "u": rng.random(3000),
+    })
+    ctx = Context()
+    ctx.create_table("l", left, chunked=True, batch_rows=700)
+    ctx.create_table("r", right, chunked=True, batch_rows=700)
+    got = ctx.sql(
+        "SELECT l.s AS s, SUM(l.v + r.u) AS t FROM l "
+        "JOIN r ON l.s = r.s GROUP BY l.s", return_futures=False)
+    j = left.merge(right, on="s")
+    want = j.assign(t=j.v + j.u).groupby("s", as_index=False).agg(
+        t=("t", "sum"))
+    _assert_frames(got, want)
+
+
+def test_aggregate_side_defers_to_iterative(ooc_ctx):
+    # TPC-H Q17 shape: a join side containing an AGGREGATE over a chunked
+    # scan is NOT row-local — per-batch partitioning would average each
+    # batch separately.  The grace path must decline so the iterative
+    # one-subtree-at-a-time strategy lowers the subquery first (regression:
+    # grace hijacked Q17 and returned per-batch averages).
+    ctx, fact, dim = ooc_ctx
+    c0 = tel.REGISTRY.counters()
+    got = ctx.sql(
+        "SELECT SUM(fact.val) AS s FROM fact JOIN "
+        "(SELECT tag AS t, AVG(val) AS a FROM fact GROUP BY tag) AS sub "
+        "ON fact.tag = sub.t WHERE fact.val < sub.a",
+        return_futures=False)
+    avg = fact.groupby("tag")["val"].transform("mean")
+    want = pd.DataFrame({"s": [fact.val[fact.val < avg].sum()]})
+    _assert_frames(got, want)
+    c1 = tel.REGISTRY.counters()
+    assert c1.get("morsel_joins", 0) == c0.get("morsel_joins", 0)
+
+
+def test_spilled_marker_on_query_report(ooc_ctx):
+    ctx, fact, dim = ooc_ctx
+    ctx.sql("SELECT COUNT(*) AS n FROM fact JOIN dim ON fact.fk = dim.dk",
+            return_futures=False)
+    report = ctx.last_report
+    assert report is not None and report.spilled
+    assert report.to_dict()["spilled"] is True
+    # a plain chunked scan does NOT carry the marker
+    ctx.sql("SELECT SUM(val) AS s FROM fact", return_futures=False)
+    assert not ctx.last_report.spilled
+
+
+def test_spill_disabled_restores_unsupported(monkeypatch, tmp_path):
+    monkeypatch.setenv("DSQL_SPILL_MB", "0")
+    monkeypatch.setenv("DSQL_SPILL_DIR", str(tmp_path))
+    spill_mod.reset_store()
+    fact, dim = _data()
+    ctx = Context()
+    ctx.create_table("fact", fact, chunked=True, batch_rows=BATCH)
+    ctx.create_table("dim", dim, chunked=True, batch_rows=BATCH)
+    c0 = tel.REGISTRY.counters()
+    with pytest.raises(StreamingUnsupported):
+        ctx.sql("SELECT COUNT(*) AS n FROM fact "
+                "JOIN dim ON fact.fk = dim.dk", return_futures=False)
+    # single-chunked streaming is untouched by the kill switch
+    got = ctx.sql("SELECT tag, SUM(val) AS s FROM fact GROUP BY tag",
+                  return_futures=False)
+    want = fact.groupby("tag", as_index=False).agg(s=("val", "sum"))
+    _assert_frames(got, want)
+    c1 = tel.REGISTRY.counters()
+    assert c1.get("spill_partitions", 0) == c0.get("spill_partitions", 0)
+    spill_mod.reset_store()
+
+
+def test_tiny_host_budget_disk_round_trip(monkeypatch, tmp_path):
+    # 1 MB host budget + ~2.5 MB of partition payload: runs must round-trip
+    # through the disk tier mid-join and the answer must not notice
+    monkeypatch.setenv("DSQL_SPILL_MB", "1")
+    monkeypatch.setenv("DSQL_SPILL_DIR", str(tmp_path))
+    spill_mod.reset_store()
+    rng = np.random.default_rng(9)
+    n = 50_000
+    fact = pd.DataFrame({
+        "fk": rng.integers(0, N_DIM, n),
+        "val": rng.random(n),
+        "e1": rng.random(n), "e2": rng.random(n), "e3": rng.random(n),
+    })
+    _, dim = _data(seed=9)
+    ctx = Context()
+    ctx.create_table("fact", fact, chunked=True, batch_rows=8192)
+    ctx.create_table("dim", dim, chunked=True, batch_rows=BATCH)
+    c0 = tel.REGISTRY.counters()
+    got = ctx.sql(
+        "SELECT dim.grp AS grp, SUM(fact.val) AS s, SUM(fact.e1) AS s1 "
+        "FROM fact JOIN dim ON fact.fk = dim.dk GROUP BY dim.grp",
+        return_futures=False)
+    j = fact.merge(dim, left_on="fk", right_on="dk")
+    want = j.groupby("grp", as_index=False).agg(s=("val", "sum"),
+                                                s1=("e1", "sum"))
+    _assert_frames(got, want)
+    c1 = tel.REGISTRY.counters()
+    assert c1.get("spill_flushes", 0) > c0.get("spill_flushes", 0)
+    stats = spill_mod.get_store().stats()
+    assert stats["runs"] == 0 and stats["disk_bytes"] == 0
+    spill_mod.reset_store()

@@ -15,6 +15,9 @@ def _instance(name: str):
         return F.FaultInjected("compile", 1)
     if name == "FatalFaultInjected":
         return F.FatalFaultInjected("compile", 1)
+    if name.startswith("Spill"):
+        from dask_sql_tpu.runtime import spill as S
+        return getattr(S, name)("boom")
     return getattr(R, name)("boom")
 
 

@@ -1,0 +1,241 @@
+"""Spill manager unit tests (runtime/spill.py) + host partition codes
+(physical/morsel.py partition_codes).
+
+The store's contract: byte-accounted three-tier chunk runs whose payloads
+survive any tier movement bit-for-bit, typed SpillCorrupt on unreadable
+disk chunks, and partition codes that send EQUAL keys to EQUAL partitions
+regardless of which side of a join (mask presence, physical dtype, or
+string dictionary) they came from — the property grace-hash joins are
+built on.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from dask_sql_tpu.physical.morsel import partition_codes
+from dask_sql_tpu.runtime import spill as spill_mod
+from dask_sql_tpu.runtime.spill import SpillCorrupt, SpillStore
+from dask_sql_tpu.types import BIGINT, DOUBLE, VARCHAR
+
+
+@pytest.fixture
+def store(monkeypatch, tmp_path):
+    monkeypatch.setenv("DSQL_SPILL_MB", "64")
+    monkeypatch.setenv("DSQL_SPILL_DIR", str(tmp_path))
+    return SpillStore()
+
+
+def _cols(n, seed=0):
+    rng = np.random.default_rng(seed)
+    data = rng.random(n)
+    mask = rng.random(n) > 0.1
+    ints = rng.integers(0, 1000, n)
+    return [(data, mask, DOUBLE, None), (ints, None, BIGINT, None)]
+
+
+def _assert_cols_equal(got, want):
+    assert len(got) == len(want)
+    for (gd, gm, *_), (wd, wm, *_) in zip(got, want):
+        np.testing.assert_array_equal(gd, wd)
+        if wm is None:
+            assert gm is None
+        else:
+            np.testing.assert_array_equal(gm, wm)
+
+
+# ---------------------------------------------------------------------------
+# host tier
+# ---------------------------------------------------------------------------
+
+def test_host_round_trip(store):
+    a, b = _cols(100, seed=1), _cols(50, seed=2)
+    assert store.put_host("r1", ["x", "y"], a) == 0
+    assert store.put_host("r1", ["x", "y"], b) == 1
+    assert store.n_chunks("r1") == 2
+    assert store.run_rows("r1") == 150
+    names, got = store.get_host_cols("r1", 0)
+    assert names == ["x", "y"]
+    _assert_cols_equal(got, a)
+    _, got = store.get_host_cols("r1", 1)
+    _assert_cols_equal(got, b)
+    meta_names, stypes, dicts, rows = store.chunk_meta("r1", 1)
+    assert meta_names == ["x", "y"]
+    assert stypes == [DOUBLE, BIGINT]
+    assert rows == 50
+    assert store.host_bytes > 0
+    store.free_run("r1")
+    assert store.host_bytes == 0
+    assert not store.has_run("r1")
+
+
+def test_stats_and_snapshot(store):
+    store.put_host("r1", ["x", "y"], _cols(10))
+    s = store.stats()
+    assert s["runs"] == 1 and s["chunks"] == 1 and s["host_bytes"] > 0
+    snap = store.runs_snapshot()
+    assert len(snap) == 1
+    assert snap[0]["run"] == "r1"
+    assert snap[0]["host_chunks"] == 1 and snap[0]["disk_chunks"] == 0
+
+
+# ---------------------------------------------------------------------------
+# disk tier
+# ---------------------------------------------------------------------------
+
+def test_disk_flush_lru_order_and_reload(store, monkeypatch, tmp_path):
+    # ~0.9 MB per chunk against a 2 MB budget: chunk 0 (coldest) must
+    # flush to disk when chunk 2 arrives, hotter chunks stay resident
+    monkeypatch.setenv("DSQL_SPILL_MB", "2")
+    chunks = [_cols(60_000, seed=i) for i in range(3)]
+    for c in chunks:
+        store.put_host("r", ["x", "y"], c)
+    snap = store.runs_snapshot()[0]
+    assert snap["disk_chunks"] >= 1
+    assert store.disk_bytes > 0
+    # the COLDEST chunk went first
+    tier0 = store.get_chunk("r", 2)[0]
+    assert tier0 == "host"
+    assert any(f.endswith(".npz") for f in os.listdir(tmp_path))
+    # reload round-trips bit-for-bit and consumes the file
+    _, got = store.get_host_cols("r", 0)
+    _assert_cols_equal(got, chunks[0])
+    store.free_run("r")
+    assert store.host_bytes == 0 and store.disk_bytes == 0
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".npz")]
+
+
+def test_reload_never_self_evicts(store, monkeypatch):
+    # regression: a chunk LARGER than the whole host budget must still be
+    # readable after its disk round-trip — the budget sweep that runs
+    # after a load pins the chunk being handed out (an unpinned sweep
+    # flushed it straight back and the caller saw None payloads)
+    monkeypatch.setenv("DSQL_SPILL_MB", "1")
+    big = _cols(200_000, seed=7)  # ~2.4 MB > 1 MB budget
+    store.put_host("r", ["x", "y"], big)
+    assert store.runs_snapshot()[0]["disk_chunks"] == 1
+    _, got = store.get_host_cols("r", 0)
+    _assert_cols_equal(got, big)
+
+
+def test_corrupt_disk_chunk_raises_typed(store, monkeypatch, tmp_path):
+    monkeypatch.setenv("DSQL_SPILL_MB", "1")
+    store.put_host("r", ["x", "y"], _cols(200_000, seed=3))
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".npz")]
+    assert files
+    with open(tmp_path / files[0], "wb") as f:
+        f.write(b"not an npz payload")
+    with pytest.raises(SpillCorrupt):
+        store.get_chunk("r", 0)
+
+
+# ---------------------------------------------------------------------------
+# device tier
+# ---------------------------------------------------------------------------
+
+def _device_table(n=64, seed=0):
+    import jax.numpy as jnp
+
+    from dask_sql_tpu.table import Column, Table
+
+    rng = np.random.default_rng(seed)
+    host = rng.random(n)
+    return host, Table(["v"], [Column(jnp.asarray(host), DOUBLE, None,
+                                      None)])
+
+
+def test_device_round_trip_and_shrink_demotion(store):
+    host, table = _device_table(seed=11)
+    store.put_table("d", table)
+    tier, names, payload = store.get_chunk("d", 0)
+    assert tier == "device" and names == ["v"]
+    assert store.device_bytes > 0
+    assert store.peak_device_bytes >= store.device_bytes
+    # ledger-tenant hook: shrink demotes device chunks to host layout
+    store.shrink_device_to(0)
+    assert store.device_bytes == 0
+    tier, _, _ = store.get_chunk("d", 0)
+    assert tier == "host"
+    _, got = store.get_host_cols("d", 0)
+    np.testing.assert_allclose(got[0][0], host)
+
+
+def test_device_cap_demotes_oversized_puts(store, monkeypatch):
+    monkeypatch.setenv("DSQL_SPILL_DEVICE_MB", "0")
+    _, table = _device_table(seed=12)
+    store.put_table("d", table)
+    tier, _, _ = store.get_chunk("d", 0)
+    assert tier == "host"
+    assert store.device_bytes == 0
+
+
+# ---------------------------------------------------------------------------
+# partition codes (physical/morsel.py)
+# ---------------------------------------------------------------------------
+
+def test_partition_codes_conservation_and_null_slots():
+    rng = np.random.default_rng(0)
+    n, P = 5000, 8
+    keys = rng.integers(0, 100, n)
+    mask = rng.random(n) > 0.05
+    cols = [(keys, mask, BIGINT, None)]
+    codes = partition_codes(cols, [0], P)
+    assert codes.dtype == np.int64
+    # NULL keys -> dead slot -1; every live row routed in [0, P)
+    np.testing.assert_array_equal(codes == -1, ~mask)
+    live = codes[mask]
+    assert live.min() >= 0 and live.max() < P
+    # conservation: regrouping by code loses no live rows
+    assert sum((codes == p).sum() for p in range(P)) == mask.sum()
+
+
+def test_partition_codes_mask_presence_consistent():
+    # one side's key column carries a mask, the other side's doesn't —
+    # equal keys MUST land in equal partitions anyway
+    keys = np.arange(1000, dtype=np.int64) % 97
+    with_mask = partition_codes([(keys, np.ones(1000, bool), BIGINT,
+                                  None)], [0], 16)
+    without = partition_codes([(keys, None, BIGINT, None)], [0], 16)
+    np.testing.assert_array_equal(with_mask, without)
+
+
+def test_partition_codes_mixed_dtype_consistent():
+    # int okey on one side, float okey on the other (TPC-H Q3 after a
+    # NULL-able encode): 5 and 5.0 must agree on their partition
+    ints = np.arange(2000, dtype=np.int64) % 311
+    floats = ints.astype(np.float64)
+    ci = partition_codes([(ints, None, BIGINT, None)], [0], 8)
+    cf = partition_codes([(floats, None, DOUBLE, None)], [0], 8)
+    np.testing.assert_array_equal(ci, cf)
+
+
+def test_partition_codes_cross_dictionary_consistent():
+    # the same string VALUES under two different (sorted) dictionaries:
+    # codes differ per table, value hashes must not
+    values = np.array(["ape", "bat", "cat", "dog", "eel"], dtype=object)
+    d1 = np.array(["ape", "bat", "cat", "dog", "eel"], dtype=object)
+    d2 = np.array(["ant", "ape", "bat", "cat", "dog", "eel", "fox"],
+                  dtype=object)
+    codes1 = np.array([0, 1, 2, 3, 4] * 40, dtype=np.int32)
+    codes2 = np.array([1, 2, 3, 4, 5] * 40, dtype=np.int32)  # same values
+    c1 = partition_codes([(codes1, None, VARCHAR, d1)], [0], 8)
+    c2 = partition_codes([(codes2, None, VARCHAR, d2)], [0], 8)
+    np.testing.assert_array_equal(c1, c2)
+
+
+def test_partition_codes_multi_key():
+    rng = np.random.default_rng(1)
+    n, P = 3000, 16
+    a = rng.integers(0, 50, n)
+    b = rng.integers(0, 50, n)
+    m = rng.random(n) > 0.03
+    codes = partition_codes([(a, None, BIGINT, None),
+                             (b, m, BIGINT, None)], [0, 1], P)
+    np.testing.assert_array_equal(codes == -1, ~m)
+    # equal (a, b) pairs agree on partition
+    lookup = {}
+    for i in range(n):
+        if not m[i]:
+            continue
+        key = (int(a[i]), int(b[i]))
+        assert lookup.setdefault(key, int(codes[i])) == int(codes[i])
