@@ -705,10 +705,14 @@ def _stage_main():
         # carries the compile stats and memory evidence for the artifact
         mem = {}
         try:
-            stats = jax.local_devices()[0].memory_stats() or {}
-            for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
-                if k in stats:
-                    mem[k] = int(stats[k])
+            # sum across ALL local devices: a mesh run that only reads
+            # device[0] under-reports HBM by the device count
+            for dev in jax.local_devices():
+                stats = dev.memory_stats() or {}
+                for k in ("bytes_in_use", "peak_bytes_in_use",
+                          "bytes_limit"):
+                    if k in stats:
+                        mem[k] = mem.get(k, 0) + int(stats[k])
         except Exception:
             pass
         # the axon backend exposes no allocator stats; account for at
@@ -915,9 +919,27 @@ def main():
                     "status": "warmup in flight when time ran out"}
             else:
                 missing_detail[str(q)] = {"status": "never started"}
+        # schema-versioned headline: the handful of numbers every consumer
+        # (scripts/perf_sentinel.py, the BENCH_r*.json trajectory) compares
+        # across runs without spelunking through detail
+        fa_vals = list(first_arrival.values())
+        headline = {
+            "schema": 1,
+            "first_arrival_sec": (round(_geomean(fa_vals), 4)
+                                  if fa_vals else None),
+            "program_store_hit_rate": (
+                round(restart_info["program_store_hits"]
+                      / max(restart_info["program_store_hits"]
+                            + restart_info["compiles"], 1), 3)
+                if restart_info else None),
+            "vs_pandas_geomean": None,
+            "warm_exec_geomean_sec": None,
+            "compile_errors": int(cstats.get("compile_errors", 0)),
+        }
         if not done:
             out = {"metric": "tpch_q1_q22_geomean_wall", "value": -1,
                    "unit": "s", "vs_baseline": 0,
+                   "headline": headline,
                    "detail": {"error": "no engine queries completed",
                               "reason": reason,
                               "sf": state["sf"],
@@ -946,11 +968,14 @@ def main():
             ratio = (_geomean([p_times[q] / times[q] for q in based])
                      if based else 0.0)
             wins = sum(1 for q in based if times[q] < p_times[q])
+            headline["vs_pandas_geomean"] = round(ratio, 3)
+            headline["warm_exec_geomean_sec"] = round(geo_e, 4)
             out = {
                 "metric": "tpch_q1_q22_geomean_wall",
                 "value": round(geo_e, 4),
                 "unit": "s (geomean over completed queries, lower is better)",
                 "vs_baseline": round(ratio, 3),
+                "headline": headline,
                 "detail": {
                     "sf": state["sf"],
                     "platform": "/".join(sorted(platforms)),

@@ -387,13 +387,19 @@ class Context:
         cache = _rc.get_cache()
         ckey = _rc.plan_key(plan, self) if cache.enabled() else None
         if ckey is not None:
-            hit = cache.get(ckey)
-            if hit is not None:
-                table, tier = hit
-                _tel.inc("result_cache_hits")
-                _tel.annotate(result_cache="hit", result_cache_tier=tier)
-                return table
-            _tel.inc("result_cache_misses")
+            # EXPLAIN PROFILE measures a real execution: the lookup is
+            # skipped (the store below still refreshes the entry)
+            if getattr(self, "_rc_bypass", False):
+                _tel.annotate(result_cache="bypass")
+            else:
+                hit = cache.get(ckey)
+                if hit is not None:
+                    table, tier = hit
+                    _tel.inc("result_cache_hits")
+                    _tel.annotate(result_cache="hit",
+                                  result_cache_tier=tier)
+                    return table
+                _tel.inc("result_cache_misses")
         # flight recorder (runtime/flight_recorder.py): stamp the canonical
         # plan fingerprint on the execute span so the completion envelope
         # and the EWMA statistics history key to it.  Env-gated BEFORE the

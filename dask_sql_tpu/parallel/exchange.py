@@ -106,6 +106,27 @@ def exchange_bytes(codes: jax.Array, payloads: Sequence[jax.Array],
     return total
 
 
+def gather_bytes(arrs: Sequence[jax.Array], n_dev: int) -> int:
+    """Static estimate of bytes moved by all_gather-ing ``arrs``: every
+    device receives every other device's shard (same trace-time shape
+    accounting as :func:`exchange_bytes`)."""
+    total = 0
+    for a in arrs:
+        total += int(a.size) * a.dtype.itemsize * n_dev * n_dev
+    return total
+
+
+def psum_bytes(arrs: Sequence[jax.Array], n_dev: int) -> int:
+    """Static estimate of bytes reduced by psum-ing ``arrs`` across the
+    mesh (ring all-reduce moves ~2× the buffer per device; this reports
+    the simpler buffer × n_dev upper-bound volume, consistent with the
+    other per-kind estimates)."""
+    total = 0
+    for a in arrs:
+        total += int(a.size) * a.dtype.itemsize * n_dev
+    return total
+
+
 def gather_build(arr: jax.Array, axis: str = ROW_AXIS) -> jax.Array:
     """all_gather a (small) build-side array: the replicate half of the
     broadcast join.  tiled=True concatenates shards along rows."""

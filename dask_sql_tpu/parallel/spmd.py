@@ -375,6 +375,15 @@ class _Walker:
         bkey, _ = self._join_key(build, bi, sent)
         pkey, _ = self._join_key(probe, pi, sent)
         if build.sharded:
+            # collective accounting by kind: the key plus every build
+            # column (data + mask) rides an all_gather in _gather_cols
+            gathered = [bkey]
+            for c in build.table.columns:
+                gathered.append(c.data)
+                if c.mask is not None:
+                    gathered.append(c.mask)
+            self._count("spmd_all_gather_bytes",
+                        X.gather_bytes(gathered, self.n_dev))
             bkey = X.gather_build(bkey)
         idx, hit, dup = X.sorted_probe(bkey, pkey, sent)
         # tagged with the decision index so the stage runner can flip this
@@ -477,6 +486,11 @@ class _Walker:
             c = PA.global_count(ok, src.sharded)
             cols.append(Column(m.reshape(1).astype(out_dt), f.stype,
                                (c > 0).reshape(1)))
+        if src.sharded:
+            # global partials are scalar psums: tiny, but the per-kind
+            # ledger stays complete
+            self._count("spmd_psum_bytes",
+                        X.psum_bytes([c.data for c in cols], self.n_dev))
         names = [f.name for f in rel.schema]
         return _ST(Table(names, cols), None, sharded=False)
 
@@ -530,6 +544,11 @@ class _Walker:
         slot = jnp.where(rows_ok, code, G).astype(jnp.int32)
 
         def combine(arr, is_minmax, is_min):
+            if src.sharded:
+                # psum / pmin / pmax are all mesh reductions of the slot
+                # table: account them under the psum kind
+                self._count("spmd_psum_bytes",
+                            X.psum_bytes([arr], self.n_dev))
             if not is_minmax:
                 return PA.psum_table(arr, src.sharded)
             if not src.sharded:
@@ -589,6 +608,9 @@ class _Walker:
         self._flag("group_cap_overflow", X.replicated_flag(overflow))
 
         def combine(arr, is_minmax, is_min):
+            if src.sharded:
+                self._count("spmd_all_gather_bytes",
+                            X.gather_bytes([arr], self.n_dev))
             return PA.gather_groups(arr, src.sharded)
 
         rows = combine(PA.slot_count(rows_ok, slot, cap), False, False)
@@ -906,6 +928,24 @@ def _pstore_save(digest: str, fn, n_args: int, n_outs: int) -> None:
                          "n_args": int(n_args), "n_outs": int(n_outs)})
 
 
+def _annotate_stage_cost(fn) -> None:
+    """Put the stage program's XLA cost prediction on the current span
+    (EXPLAIN PROFILE and the query report's cost_err read it there).
+    Env-gated before any profiler import; AOT/deserialized executables
+    without a cost model just annotate nothing."""
+    from ..physical.compiled import _profile_on
+    if not _profile_on():
+        return
+    try:
+        from ..runtime import profiler as _prof
+        cost = _prof.cost_summary(fn)
+        if cost is not None:
+            _tel.annotate(cost_flops=cost["flops"],
+                          cost_bytes=cost["bytes"])
+    except Exception:
+        logger.debug("spmd cost capture failed", exc_info=True)
+
+
 def _execute_stage_program(wrapped, flat, n_outs: int, digest: str,
                            counts: Dict[str, int]):
     """in-process cache -> persistent store -> AOT compile."""
@@ -914,6 +954,7 @@ def _execute_stage_program(wrapped, flat, n_outs: int, digest: str,
         if fn is not None:
             _prog_cache.move_to_end(digest)
     if fn is not None:
+        _annotate_stage_cost(fn)
         return fn(*flat)
 
     hit = _pstore_load(digest, flat, n_outs)
@@ -925,6 +966,7 @@ def _execute_stage_program(wrapped, flat, n_outs: int, digest: str,
         counts["spmd_compiles"] = counts.get("spmd_compiles", 0) + 1
         _pstore_save(digest, fn, len(flat), n_outs)
         outs = fn(*flat)
+    _annotate_stage_cost(fn)
     with _prog_lock:
         _prog_cache[digest] = fn
         while len(_prog_cache) > _PROG_CACHE_CAP:
@@ -1032,6 +1074,17 @@ def _run_stage(stage, context, mesh, counts: Dict[str, int]):
             counts["spmd_join_flips"] = (counts.get("spmd_join_flips", 0)
                                          + len(e.tripped))
             continue
+        if valid is not None and _C._profile_on():
+            # per-shard row counts -> skew ratio (max/mean): one host
+            # fetch of the validity vector, paid only when profiling
+            try:
+                per = np.asarray(valid).reshape(n_dev, -1).sum(axis=1)
+                mean = float(per.mean())
+                if mean > 0:
+                    meta["skew_ratio"] = round(float(per.max()) / mean, 3)
+                    meta["shard_rows"] = [int(x) for x in per]
+            except Exception:
+                logger.debug("spmd skew probe failed", exc_info=True)
         return table, valid, meta
 
 
@@ -1100,8 +1153,16 @@ def try_execute_spmd(plan: RelNode, context) -> Optional[Table]:
     metas: List[Dict] = []
     try:
         result = None
-        for stage in graph.stages:
-            table, valid, meta = _run_stage(stage, context, mesh, counts)
+        for si, stage in enumerate(graph.stages):
+            # one span per SPMD stage: the stage program's cost
+            # annotations and the shard-skew probe land here, giving
+            # EXPLAIN PROFILE its per-stage rows
+            with _tel.span("spmd_stage", index=si):
+                table, valid, meta = _run_stage(stage, context, mesh,
+                                                counts)
+                if meta.get("skew_ratio") is not None:
+                    _tel.annotate(skew_ratio=meta["skew_ratio"],
+                                  shard_rows=meta["shard_rows"])
             metas.append(meta)
             if stage.scan is not None:
                 name = stage.scan.table_name
@@ -1134,17 +1195,37 @@ def try_execute_spmd(plan: RelNode, context) -> Optional[Table]:
     for k, v in counts.items():
         _tel.inc(k, v)
     bytes_moved = 0
+    gather_moved = 0
+    psum_moved = 0
+    skew = None
     for meta in metas:
         for k, v in meta["counts"].items():
             _tel.inc(k, v)
             if k == "spmd_exchange_bytes":
                 bytes_moved += int(v)
+            elif k == "spmd_all_gather_bytes":
+                gather_moved += int(v)
+            elif k == "spmd_psum_bytes":
+                psum_moved += int(v)
+        r = meta.get("skew_ratio")
+        if r is not None:
+            skew = max(skew, r) if skew is not None else r
         for op, variant, info in meta["decisions"]:
             try:
                 record_choice(op, variant, **info)
             except Exception:  # pragma: no cover
                 pass
-    _tel.annotate(tier="spmd", spmd_devices=n_dev,
-                  spmd_stages=len(graph.stages),
-                  spmd_exchange_bytes=bytes_moved)
+    ann = dict(tier="spmd", spmd_devices=n_dev,
+               spmd_stages=len(graph.stages),
+               spmd_exchange_bytes=bytes_moved)
+    # per-kind collective accounting + worst-stage shard skew annotate
+    # ONLY here (the query report sums byte attrs over all spans, so the
+    # per-stage spans deliberately do not repeat them)
+    if gather_moved:
+        ann["spmd_all_gather_bytes"] = gather_moved
+    if psum_moved:
+        ann["spmd_psum_bytes"] = psum_moved
+    if skew is not None:
+        ann["skew_ratio"] = skew
+    _tel.annotate(**ann)
     return result

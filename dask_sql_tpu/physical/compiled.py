@@ -2021,6 +2021,12 @@ def _pstore_digest(base_key) -> str:
     return _pstore.get_store().digest(_canonical_program_key(base_key))
 
 
+def _profile_on() -> bool:
+    """Device profiler armed?  Checked BEFORE importing runtime.profiler
+    so a disabled profiler costs one env read and zero imports."""
+    return os.environ.get("DSQL_PROFILE", "0").strip() not in ("", "0")
+
+
 def _pstore_put(entry: _Compiled, base_key, n_args: int, n_outs: int
                 ) -> None:
     """Serialize + persist a freshly compiled program (best-effort; only
@@ -2037,7 +2043,7 @@ def _pstore_put(entry: _Compiled, base_key, n_args: int, n_outs: int
         _tel.inc("program_store_errors")
         logger.debug("program serialize failed (%s); not persisted", e)
         return
-    store.store(_pstore_digest(base_key), {
+    rec = {
         "v": 1,
         "caps": {k: int(v) for k, v in entry.caps.items()},
         "spec": entry.spec,
@@ -2045,10 +2051,22 @@ def _pstore_put(entry: _Compiled, base_key, n_args: int, n_outs: int
         "payload": payload,
         "n_args": int(n_args),
         "n_outs": int(n_outs),
-    })
+    }
+    # XLA cost analysis rides the entry (missing-tolerant: backends
+    # without a cost model simply omit the key) so a warm process has
+    # cost estimates with zero recompilation (runtime/profiler.py)
+    if _profile_on():
+        try:
+            from ..runtime import profiler as _prof
+            cost = _prof.cost_summary(entry.fn)
+            if cost is not None:
+                rec["cost"] = cost
+        except Exception:
+            logger.debug("cost capture at store failed", exc_info=True)
+    store.store(_pstore_digest(base_key), rec)
 
 
-def _pstore_attempt(base_key, flat):
+def _pstore_attempt(base_key, flat, query_fp: str = ""):
     """Load + execute this program from the persistent store.
 
     Returns (entry, outs, caps) on a hit — the executable deserialized
@@ -2087,6 +2105,21 @@ def _pstore_attempt(base_key, flat):
         return None
     _tel.inc("program_store_hits")
     _tel.annotate(program_store="hit")
+    # the persisted cost analysis (when the storing process captured one)
+    # seeds this process's model-vs-measured ledger without a recompile;
+    # keyed under the ROOT query's fingerprint so the scheduler's
+    # cost_model rung finds it
+    if _profile_on():
+        cost = raw.get("cost")
+        if cost:
+            try:
+                from ..runtime import profiler as _prof
+                _prof.record_program_cost(query_fp,
+                                          _pstore_digest(base_key), cost)
+                _tel.annotate(cost_flops=cost.get("flops"),
+                              cost_bytes=cost.get("bytes"))
+            except Exception:
+                logger.debug("cost ledger seed failed", exc_info=True)
     return entry, outs, caps
 
 
@@ -2559,7 +2592,9 @@ def _compile_workers(n_stages: Optional[int] = None) -> int:
 def _record_stage_stats(st, idx: int, out: Table, query_fp: str,
                         stage_rows: Dict[int, int], wall_ms: float) -> None:
     """One flight-recorder stats record per executed stage (callers gate
-    on DSQL_HISTORY_FILE — the disabled path never reaches here).
+    on DSQL_HISTORY_FILE or DSQL_PROFILE — the fully-disabled path never
+    reaches here; with only the profiler armed, the span annotations and
+    the measured-side ledger fold still happen but nothing is journaled).
 
     The digest is the stage's boundary-table content digest
     (_stage_table_name) — the canonical stage fingerprint the EWMA history
@@ -2590,10 +2625,18 @@ def _record_stage_stats(st, idx: int, out: Table, query_fp: str,
         # stage_bytes into the query's measured working set at close
         _tel.annotate(stage_digest=digest, stage_rows_in=rows_in,
                       stage_rows_out=rows_out, stage_capacity=capacity,
-                      stage_bytes=nbytes)
-        _fr.record_stage(digest, rows_in=rows_in, rows_out=rows_out,
-                         capacity=capacity, nbytes=nbytes, wall_ms=wall_ms,
-                         device_ms=device_ms or None, query_fp=query_fp)
+                      stage_bytes=nbytes, stage_wall_ms=round(wall_ms, 3))
+        if _profile_on():
+            # measured side of the model-vs-measured ledger: what the
+            # stage actually touched, against the compile-time prediction
+            from ..runtime import profiler as _prof
+            _prof.record_measured(digest, nbytes=nbytes, wall_ms=wall_ms,
+                                  device_ms=device_ms or None)
+        if os.environ.get("DSQL_HISTORY_FILE"):
+            _fr.record_stage(digest, rows_in=rows_in, rows_out=rows_out,
+                             capacity=capacity, nbytes=nbytes,
+                             wall_ms=wall_ms, device_ms=device_ms or None,
+                             query_fp=query_fp)
     except Exception:  # recording must never fail a stage
         _tel.inc("history_errors")
         logger.debug("stage stat capture failed", exc_info=True)
@@ -2679,8 +2722,9 @@ def _execute_stage_graph_inner(graph: StageGraph, context, query_fp: str,
                 try:
                     t0s = time.perf_counter()
                     out = run_stage_once(idx, attempt)
-                    if out is not None and \
-                            os.environ.get("DSQL_HISTORY_FILE"):
+                    if out is not None and (
+                            os.environ.get("DSQL_HISTORY_FILE")
+                            or _profile_on()):
                         _record_stage_stats(
                             stages[idx], idx, out, query_fp, stage_rows,
                             (time.perf_counter() - t0s) * 1e3)
@@ -3178,7 +3222,7 @@ def _execute_single(plan: RelNode, context, query_fp: str,
             # (they were learned by actually running this program).
             store_tried = True
             with _tel.span("program_store_load"):
-                got = _pstore_attempt(base_key, flat)
+                got = _pstore_attempt(base_key, flat, query_fp)
             if got is not None:
                 loaded, outs, caps = got
                 if my_event is not None:
@@ -3234,10 +3278,13 @@ def _execute_single(plan: RelNode, context, query_fp: str,
                                 _faults.maybe_fail("compile")
                                 entry = _build(plan, context, scans, caps,
                                                key, origin=query_fp)
-                                if _pstore.get_store().enabled():
+                                if _pstore.get_store().enabled() \
+                                        or _profile_on():
                                     # AOT lower+compile: same trace, same
                                     # XLA build, but the executable object
-                                    # exists to serialize into the store
+                                    # exists to serialize into the store —
+                                    # and to read cost_analysis() from,
+                                    # which is why the profiler forces it
                                     lowered = entry.fn.lower(*flat)
                                     entry.fn = lowered.compile()
                                     entry.aot = True
@@ -3303,6 +3350,24 @@ def _execute_single(plan: RelNode, context, query_fp: str,
                         while len(_cache) >= _CACHE_LIMIT:
                             _cache.popitem(last=False)
                         _cache[key] = entry
+                    if _profile_on():
+                        # compile-time XLA cost capture: predicted
+                        # flops/bytes land on this span (EXPLAIN PROFILE
+                        # reads them there) and in the profiler ledger
+                        # under the ROOT query's fingerprint (the
+                        # scheduler's cost_model rung reads it there)
+                        try:
+                            from ..runtime import profiler as _prof
+                            cost = _prof.cost_summary(entry.fn)
+                            if cost is not None:
+                                _prof.record_program_cost(
+                                    query_fp, _pstore_digest(base_key),
+                                    cost)
+                                _tel.annotate(cost_flops=cost["flops"],
+                                              cost_bytes=cost["bytes"])
+                        except Exception:
+                            logger.debug("cost capture failed",
+                                         exc_info=True)
                     # persist the executable so a FRESH process never
                     # re-pays this compile (best-effort; outside the
                     # watchdog — serialization cannot wedge XLA)
@@ -3322,6 +3387,20 @@ def _execute_single(plan: RelNode, context, query_fp: str,
                 _tel.inc("stage_hits")
             if entry.origin is not None and entry.origin != query_fp:
                 _tel.inc("cross_query_hits")
+            if _profile_on():
+                # warm path: replay the cost prediction captured at
+                # compile/store time onto this execution's span, so a
+                # profiled re-run (EXPLAIN PROFILE included) still shows
+                # flops/bytes without recompiling
+                try:
+                    from ..runtime import profiler as _prof
+                    c = (_prof.program_costs(query_fp)
+                         .get(_pstore_digest(base_key)))
+                    if c:
+                        _tel.annotate(cost_flops=c.get("flops"),
+                                      cost_bytes=c.get("bytes"))
+                except Exception:
+                    logger.debug("cost replay failed", exc_info=True)
             with _state_lock:
                 _cache.move_to_end(key)
             if os.environ.get("DSQL_TIME_DEVICE"):

@@ -459,6 +459,14 @@ def grace_join_split(join: LogicalJoin, context):
 
         cap_l = max(max((store.run_rows(r) for r in runs_l), default=0), 1)
         cap_r = max(max((store.run_rows(r) for r in runs_r), default=0), 1)
+        # partition skew ratio (max/mean over non-empty partitions), the
+        # same attr the SPMD runner annotates — the query report and the
+        # flight-recorder envelope surface one unified skew number
+        sizes = [n for r in runs_l + runs_r
+                 if (n := store.run_rows(r)) > 0]
+        if sizes:
+            _tel.annotate(skew_ratio=round(
+                max(sizes) / (sum(sizes) / len(sizes)), 3))
         for cap, src in ((cap_l, lsrc), (cap_r, rsrc)):
             if cap > SKEW_FACTOR * max(int(src.batch_rows), 1):
                 # a hot key concentrates rows in one partition; every

@@ -229,6 +229,8 @@ def _export_model(stmt: A.ExportModel, context, sql):
 
 def _explain(stmt: A.ExplainStatement, context, sql):
     plan = context._get_plan(stmt.query, sql)
+    if getattr(stmt, "profile", False):
+        return _explain_profile(plan, context)
     if not getattr(stmt, "analyze", False):
         lines = plan.explain().splitlines()
         # predicted adaptive operator choices (runtime/statistics.py):
@@ -238,6 +240,121 @@ def _explain(stmt: A.ExplainStatement, context, sql):
         lines.extend(_stats.explain_lines(plan, context))
         return _meta_table({"PLAN": np.array(lines, dtype=object)})
     return _explain_analyze(plan, context)
+
+
+def _explain_profile(plan, context):
+    """EXPLAIN PROFILE: run the plan through the NORMAL engine path — the
+    tier dispatch, scheduler admission and SPMD/compiled execution a plain
+    run would take (unlike EXPLAIN ANALYZE's instrumented eager run) — and
+    render the device-level profile captured on its spans: per-stage
+    flops / bytes / device-ms, shard skew, collective bytes by kind,
+    per-device HBM and the cost-model error (runtime/profiler.py).
+
+    Zero-cost when the profiler is disarmed: the query is NOT executed;
+    only the plan and a pointer at ``DSQL_PROFILE`` print.
+    """
+    import os
+    import time as _time
+
+    from ...runtime import telemetry as _tel
+
+    lines = plan.explain().splitlines()
+    if os.environ.get("DSQL_PROFILE", "0").strip() in ("", "0"):
+        lines.append("-- profile: disabled (set DSQL_PROFILE=1)")
+        return _meta_table({"PLAN": np.array(lines, dtype=object)})
+
+    from ...runtime import profiler as _prof
+
+    # the result cache would short-circuit a previously-run query into a
+    # replay with no stages to profile; profiling means MEASURING an
+    # execution, so the lookup (not the store) is bypassed for this run
+    context._rc_bypass = True
+    t0 = _time.perf_counter()
+    try:
+        with _tel.span("profile_exec") as sp:
+            result = context._execute_query_plan(plan)
+    finally:
+        context._rc_bypass = False
+    wall_ms = (_time.perf_counter() - t0) * 1e3
+    rows_out = int(getattr(result, "num_rows", 0) or 0)
+    spans = list(sp.walk()) if sp is not None else []
+
+    def stat(ss, key, conv=float):
+        """Sum ``key`` over spans, or None when no span carried it."""
+        tot, seen = 0, False
+        for s in ss:
+            v = s.attrs.get(key)
+            if v:
+                tot, seen = tot + conv(v), True
+        return tot if seen else None
+
+    def fmt(v):
+        if v is None:
+            return "n/a"
+        return f"{v:.3f}" if isinstance(v, float) else str(v)
+
+    tier = next((str(s.attrs.get("tier")) for s in spans
+                 if s.attrs.get("tier")), None)
+    lines.append(f"-- profile: wall={wall_ms:.3f}ms rows_out={rows_out}"
+                 + (f" tier={tier}" if tier else ""))
+    # the admission estimate this run was charged under — "cost_model"
+    # here is the profiler's own estimate rung closing the loop
+    for s in spans:
+        if s.name == "queued":
+            lines.append(
+                f"-- estimate: source={s.attrs.get('est_source', '?')} "
+                f"bytes={s.attrs.get('est_bytes', 0)}")
+            break
+    # per-stage rows: compiled stage-graph spans and SPMD stage spans;
+    # a single-program plan renders one whole-plan row instead
+    stage_spans = [s for s in spans if s.name in ("stage", "spmd_stage")]
+    targets = stage_spans or ([sp] if sp is not None else [])
+    for s in targets:
+        ss = list(s.walk())
+        cbytes = stat(ss, "cost_bytes")
+        mbytes = stat(ss, "stage_bytes", int)
+        err = _prof.cost_error(cbytes, mbytes)
+        label = ("whole" if s is sp
+                 else f"{s.name}[{s.attrs.get('index', '?')}]")
+        lines.append(
+            f"-- stage {label}: flops={fmt(stat(ss, 'cost_flops'))} "
+            f"bytes={fmt(cbytes)} measured_bytes={fmt(mbytes)} "
+            f"device_ms={fmt(stat(ss, 'device_ms'))} "
+            f"wall_ms={s.wall_ms:.3f} "
+            f"rows={fmt(stat(ss, 'stage_rows_out', int))} "
+            f"skew={fmt(stat([s], 'skew_ratio'))} "
+            f"cost_err={fmt(err)}")
+    # shard/partition skew + collective bytes by kind, query-wide
+    skews = [float(s.attrs.get("skew_ratio")) for s in spans
+             if s.attrs.get("skew_ratio") is not None]
+    if skews:
+        lines.append(f"-- skew_ratio: {max(skews):.3f}")
+    coll = []
+    for attr, kind in (("spmd_exchange_bytes", "all_to_all"),
+                       ("spmd_all_gather_bytes", "all_gather"),
+                       ("spmd_psum_bytes", "psum")):
+        v = stat(spans, attr, int)
+        if v:
+            coll.append(f"{kind}={v}")
+    if coll:
+        lines.append("-- collectives: " + " ".join(coll))
+    # query-wide cost-model error (predicted XLA bytes vs result +
+    # materialized stage bytes — the flight-recorder definition)
+    total_pred = stat(spans, "cost_bytes")
+    res_bytes = sum(int(getattr(c.data, "nbytes", 0) or 0)
+                    for c in (getattr(result, "columns", None) or []))
+    total_meas = (stat(spans, "stage_bytes", int) or 0) + res_bytes
+    err = _prof.cost_error(total_pred, total_meas)
+    if err is not None:
+        lines.append(f"-- cost_model_error: {err:.4f}")
+    # per-device HBM truth (zeros on backends without memory_stats)
+    for d in _prof.device_memory_rows():
+        lines.append(
+            f"-- device {d['id']}: platform={d['platform']} "
+            f"kind={d['kind']} hbm_in_use={d['bytes_in_use']} "
+            f"hbm_peak={d['peak_bytes_in_use']} "
+            f"hbm_limit={d['bytes_limit']}")
+    return _meta_table({"PLAN": np.array(lines, dtype=object)})
 
 
 def _explain_analyze(plan, context):

@@ -183,13 +183,19 @@ def read_events(kind: Optional[str] = None,
 
 def _observe_stat(fp: str, nbytes: Optional[int] = None,
                   rows: Optional[int] = None,
-                  ms: Optional[float] = None) -> None:
+                  ms: Optional[float] = None,
+                  cost_bytes: Optional[float] = None,
+                  cost_flops: Optional[float] = None) -> None:
     """Fold one measurement into the per-fingerprint EWMA entry.
     Read-merge-replace (kvstore discipline): a lost race costs one
-    observation, never corruption."""
+    observation, never corruption.  ``cost_bytes``/``cost_flops`` are the
+    profiler's XLA cost-model predictions (runtime/profiler.py) — the
+    model-vs-measured ledger shares one entry with the measured EWMA so
+    the scheduler's cost_model rung survives the process boundary."""
     data = _STATS.read()
     e = dict(data.get(fp) or {})
-    for key, v in (("bytes", nbytes), ("rows", rows), ("ms", ms)):
+    for key, v in (("bytes", nbytes), ("rows", rows), ("ms", ms),
+                   ("cost_bytes", cost_bytes), ("cost_flops", cost_flops)):
         if v is None:
             continue
         prev = e.get(key)
@@ -293,6 +299,16 @@ def record_query(report, error: Optional[BaseException] = None) -> None:
         "plan_fp": plan_fp or "",
         "operators": list(getattr(report, "operators", ()) or ()),
         "phases": {k: round(v, 3) for k, v in report.phases.items()},
+        # device-level profile fields (ISSUE 13): worst shard/partition
+        # skew, collective bytes split by kind, and the XLA cost-model
+        # error vs measured bytes — so system.queries answers "which
+        # queries are skew-bound" in SQL.  Zeros when nothing annotated.
+        "skew_ratio": float(getattr(report, "skew_ratio", None) or 0.0),
+        "collective_bytes": dict(getattr(report, "collective_bytes", None)
+                                 or {}),
+        "cost_err": (float(report.cost_err)
+                     if getattr(report, "cost_err", None) is not None
+                     else -1.0),
     }
     _append(path, rec)
     if plan_fp and error is None and measured > 0:

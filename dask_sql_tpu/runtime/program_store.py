@@ -203,10 +203,17 @@ class ProgramStore:
                 pass
             return False
         now = time.time()
+        rec = {"bytes": len(blob), "used_at": now, "stored_at": now}
+        cost = entry.get("cost")
+        if isinstance(cost, dict):
+            # the profiler's XLA cost prediction rides the index too, so
+            # system.programs answers "which stored programs are heavy"
+            # without deserializing any payload
+            rec["cost_flops"] = float(cost.get("flops", 0.0) or 0.0)
+            rec["cost_bytes"] = float(cost.get("bytes", 0.0) or 0.0)
         with self._lock:
             index = self._index.read()
-            index[digest] = {"bytes": len(blob), "used_at": now,
-                             "stored_at": now}
+            index[digest] = rec
             index = self._evict_locked(index, keep=digest)
             self._index.write(index)
         _tel.inc("program_store_stores")

@@ -77,6 +77,7 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import threading
 import time
 from collections import deque
@@ -236,6 +237,21 @@ def estimate_working_set(plan, context) -> "Tuple[int, str]":
     if est is not None:
         _tel.inc("estimate_from_stats")
         return max(int(est), _MIN_ESTIMATE), "stats"
+    # fourth rung (runtime/profiler.py): the XLA cost model's "bytes
+    # accessed" for this plan's captured programs — available once the
+    # plan compiled anywhere (program-store entries persist the cost, so
+    # a warm process has it before any history accrues).  The env gate
+    # keeps the disabled path import-free, like the recorder's.
+    if os.environ.get("DSQL_PROFILE", "0").strip() not in ("", "0"):
+        try:
+            from . import profiler as _prof
+            est = _prof.plan_cost_bytes(plan, context)
+        except Exception:   # estimator must never fail a query
+            logger.debug("cost-model estimate failed", exc_info=True)
+            est = None
+        if est is not None:
+            _tel.inc("estimate_from_cost_model")
+            return max(int(est), _MIN_ESTIMATE), "cost_model"
     return estimate_plan_bytes(plan, context), "heuristic"
 
 

@@ -14,6 +14,7 @@ occupy result-cache budget or interact with catalog epochs):
 - ``system.cache``       result-cache entries with tier/bytes/hits
 - ``system.quarantine``  standing compiler-crash verdicts
 - ``system.programs``    persistent program-store index
+- ``system.devices``     per-local-device HBM in-use/peak/limit
 
 Every table has a FIXED column schema with explicit dtypes so an empty
 engine still binds and executes ``SELECT * FROM system.queries`` — object
@@ -28,7 +29,7 @@ import numpy as np
 from ..table import Table
 
 TABLE_NAMES = ("queries", "active", "metrics", "cache", "quarantine",
-               "programs", "table_stats", "mesh", "spill")
+               "programs", "table_stats", "mesh", "spill", "devices")
 
 
 def _col(rows: List[dict], key: str, dtype, default):
@@ -72,6 +73,20 @@ def _queries() -> Table:
         "operators": _col([{"operators": "; ".join(r.get("operators")
                                                    or [])}
                            for r in rows], "operators", object, ""),
+        # device-profile fields (runtime/profiler.py): worst shard skew,
+        # collective bytes by kind, and the cost-model error (-1 = not
+        # profiled / no prediction); older envelopes render the defaults
+        "skew_ratio": _col(rows, "skew_ratio", np.float64, 0.0),
+        "all_to_all_bytes": _col(
+            [{"v": (r.get("collective_bytes") or {}).get("all_to_all", 0)}
+             for r in rows], "v", np.int64, 0),
+        "all_gather_bytes": _col(
+            [{"v": (r.get("collective_bytes") or {}).get("all_gather", 0)}
+             for r in rows], "v", np.int64, 0),
+        "psum_bytes": _col(
+            [{"v": (r.get("collective_bytes") or {}).get("psum", 0)}
+             for r in rows], "v", np.int64, 0),
+        "cost_err": _col(rows, "cost_err", np.float64, -1.0),
     })
 
 
@@ -170,6 +185,10 @@ def _programs() -> Table:
         "nbytes": _col(rows, "bytes", np.int64, 0),
         "used_at": _col(rows, "used_at", np.float64, 0.0),
         "stored_at": _col(rows, "stored_at", np.float64, 0.0),
+        # XLA cost prediction captured at store time (profiler armed);
+        # zeros for entries stored without profiling
+        "cost_flops": _col(rows, "cost_flops", np.float64, 0.0),
+        "cost_bytes": _col(rows, "cost_bytes", np.float64, 0.0),
     })
 
 
@@ -245,6 +264,43 @@ def _mesh(context=None) -> Table:
     })
 
 
+def _devices() -> Table:
+    """Per-device HBM truth: one row per LOCAL device with live
+    ``memory_stats()`` readings (bytes in use / peak / limit — zeros on
+    backends without memory stats, e.g. CPU).  Deliberately reads jax
+    directly rather than importing runtime.profiler, so querying
+    ``system.devices`` keeps the profiler's zero-import guarantee when
+    ``DSQL_PROFILE`` is off."""
+    import jax
+
+    rows: List[dict] = []
+    try:
+        devices = jax.local_devices()
+    except Exception:  # pragma: no cover
+        devices = []
+    for d in devices:
+        try:
+            mem = d.memory_stats() or {}
+        except Exception:
+            mem = {}
+        rows.append({
+            "device_id": int(getattr(d, "id", len(rows))),
+            "platform": str(getattr(d, "platform", "")),
+            "kind": str(getattr(d, "device_kind", "")),
+            "bytes_in_use": int(mem.get("bytes_in_use", 0) or 0),
+            "peak_bytes_in_use": int(mem.get("peak_bytes_in_use", 0) or 0),
+            "bytes_limit": int(mem.get("bytes_limit", 0) or 0),
+        })
+    return Table.from_pydict({
+        "device_id": _col(rows, "device_id", np.int64, 0),
+        "platform": _col(rows, "platform", object, ""),
+        "kind": _col(rows, "kind", object, ""),
+        "bytes_in_use": _col(rows, "bytes_in_use", np.int64, 0),
+        "peak_bytes_in_use": _col(rows, "peak_bytes_in_use", np.int64, 0),
+        "bytes_limit": _col(rows, "bytes_limit", np.int64, 0),
+    })
+
+
 def _spill() -> Table:
     """One row per live spill run (grace-hash partition / out-of-core join
     output), with its tier placement — a mid-query `SELECT * FROM
@@ -275,6 +331,7 @@ _BUILDERS: Dict[str, object] = {
     "table_stats": _table_stats,
     "mesh": _mesh,
     "spill": _spill,
+    "devices": _devices,
 }
 
 #: builders that need the resolving context (catalog / mesh live there)

@@ -137,6 +137,15 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     "spmd_exchanges", "spmd_exchange_bytes", "spmd_partial_aggs",
     "spmd_broadcast_joins", "spmd_exchange_joins", "spmd_join_flips",
     "spmd_fallbacks", "spmd_unsupported",
+    # collective bytes by kind (parallel/spmd.py via exchange.py static
+    # estimators): spmd_exchange_bytes above is the all_to_all channel;
+    # these split out the broadcast-join gathers and psum combine trees
+    "spmd_all_gather_bytes", "spmd_psum_bytes",
+    # device-level profiler (runtime/profiler.py, DSQL_PROFILE=1):
+    # memory snapshots taken, XLA cost-analysis captures (compile or
+    # program-store load), and scheduler estimates served from the
+    # captured cost model (the ladder's fourth rung)
+    "profile_samples", "profile_cost_captures", "estimate_from_cost_model",
 )
 
 STABLE_HISTOGRAMS: Tuple[str, ...] = (
@@ -155,6 +164,11 @@ STABLE_GAUGES: Tuple[str, ...] = (
     "server_draining",
     # spill-store tier occupancy (runtime/spill.py), point-in-time
     "spill_device_bytes", "spill_host_bytes", "spill_disk_bytes",
+    # device-memory profiler (runtime/profiler.py): summed local-device
+    # HBM truth from the latest memory_stats() sample (zeros on backends
+    # without memory stats, e.g. CPU)
+    "profile_hbm_bytes_in_use", "profile_hbm_peak_bytes",
+    "profile_hbm_bytes_limit",
 )
 
 # exponential-ish bucket bounds in milliseconds; histograms are BOUNDED by
@@ -509,7 +523,8 @@ class QueryReport:
 
     __slots__ = ("query", "wall_ms", "phases", "counters", "root",
                  "rows_out", "bytes_out", "started_unix", "cache", "tier",
-                 "priority", "operators", "spilled")
+                 "priority", "operators", "spilled", "skew_ratio",
+                 "collective_bytes", "cost_err")
 
     def __init__(self, trace: QueryTrace):
         root = trace.root
@@ -580,6 +595,38 @@ class QueryReport:
         self.spilled = (self.counters.get("spill_partitions", 0) > 0
                         or any(s.attrs.get("spilled")
                                for s in root.walk()))
+        # device-level profile surface (ISSUE 13): worst shard/partition
+        # skew (max/mean row ratio — SPMD stages and grace-hash morsel
+        # joins both annotate ``skew_ratio``), collective bytes by kind,
+        # and the XLA cost-model error vs measured stage bytes; all None
+        # when nothing annotated them (profiler off / single device)
+        skew: Optional[float] = None
+        coll: Dict[str, int] = {}
+        cost_bytes = 0.0
+        measured = 0
+        for s in root.walk():
+            r = s.attrs.get("skew_ratio")
+            if r is not None:
+                skew = max(float(r), skew) if skew is not None else float(r)
+            for attr, kind in (("spmd_exchange_bytes", "all_to_all"),
+                               ("spmd_all_gather_bytes", "all_gather"),
+                               ("spmd_psum_bytes", "psum")):
+                v = s.attrs.get(attr)
+                if v:
+                    coll[kind] = coll.get(kind, 0) + int(v)
+            cb = s.attrs.get("cost_bytes")
+            if cb:
+                cost_bytes += float(cb)
+            sb = s.attrs.get("stage_bytes")
+            if sb:
+                measured += int(sb)
+        self.skew_ratio = round(skew, 3) if skew is not None else None
+        self.collective_bytes = coll or None
+        # measured working set mirrors the flight recorder's definition:
+        # result bytes plus every materialized stage boundary
+        measured += self.bytes_out
+        self.cost_err = (round(abs(cost_bytes - measured) / measured, 4)
+                         if cost_bytes and measured else None)
         self.cache = {"hit": hit, "tier": tier, "stored": stored,
                       "subplan_hits": subplan_hits,
                       "bytes": int(REGISTRY.get_gauge("result_cache_bytes")),
@@ -598,6 +645,9 @@ class QueryReport:
                 "priority": self.priority,
                 "operators": list(self.operators),
                 "spilled": self.spilled,
+                "skew_ratio": self.skew_ratio,
+                "collective_bytes": self.collective_bytes,
+                "cost_err": self.cost_err,
                 "rows_out": self.rows_out, "bytes_out": self.bytes_out,
                 "spans": self.root.to_dict()}
 
@@ -616,6 +666,13 @@ class QueryReport:
             lines.append("operators: " + "; ".join(self.operators))
         if self.spilled:
             lines.append("spilled: true")
+        if self.skew_ratio is not None:
+            lines.append(f"skew_ratio: {self.skew_ratio}")
+        if self.collective_bytes:
+            lines.append("collective_bytes: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(self.collective_bytes.items())))
+        if self.cost_err is not None:
+            lines.append(f"cost_err: {self.cost_err}")
 
         def walk(s: Span, depth: int):
             attrs = "".join(f" {k}={v}" for k, v in sorted(s.attrs.items()))
@@ -718,10 +775,14 @@ def _close_trace(trace: QueryTrace, error: Optional[BaseException]) -> None:
         REGISTRY.inc("slow_queries")
         logger.warning(
             "slow query (%.0f ms >= DSQL_SLOW_QUERY_MS=%.0f): %s | tier: %s "
-            "| cacheHit: %s | priority: %s | phases: %s | counters: %s",
+            "| cacheHit: %s | priority: %s | skew: %s | collectives: %s "
+            "| costErr: %s | phases: %s | counters: %s",
             report.wall_ms, slow_ms, report.query.strip()[:500],
             report.tier or "eager", bool(report.cache.get("hit")),
             report.priority or "-",
+            report.skew_ratio if report.skew_ratio is not None else "-",
+            report.collective_bytes or "-",
+            report.cost_err if report.cost_err is not None else "-",
             {k: round(v, 1) for k, v in sorted(report.phases.items())},
             dict(sorted(report.counters.items())))
 
@@ -736,6 +797,15 @@ def _close_trace(trace: QueryTrace, error: Optional[BaseException]) -> None:
         except Exception:
             REGISTRY.inc("history_errors")
             logger.debug("flight recorder append failed", exc_info=True)
+
+    # device profiler (runtime/profiler.py): same env-gate-before-import
+    # discipline — DSQL_PROFILE=0 costs one dict lookup, zero imports
+    if os.environ.get("DSQL_PROFILE", "0").strip() not in ("", "0"):
+        try:
+            from . import profiler as _prof
+            _prof.on_query_complete(report)
+        except Exception:
+            logger.debug("profiler query hook failed", exc_info=True)
 
 
 @contextmanager

@@ -236,8 +236,17 @@ def _run_tracked(context, sql: str, info: _QueryInfo,
                          for c in table.columns)
     try:
         import jax
-        mem = jax.local_devices()[0].memory_stats() or {}
-        info.peak_memory = int(mem.get("peak_bytes_in_use", 0))
+        # sum peaks over EVERY local device: on a real mesh the query's
+        # working set is sharded, so device 0 alone understates (or on an
+        # idle coordinator, misses entirely) the true footprint
+        peak = 0
+        for d in jax.local_devices():
+            try:
+                mem = d.memory_stats() or {}
+            except Exception:
+                mem = {}
+            peak += int(mem.get("peak_bytes_in_use", 0) or 0)
+        info.peak_memory = peak
     except Exception as e:  # telemetry only; never fail the query over it
         logger.debug("memory_stats unavailable: %s", e)
     return table
@@ -364,7 +373,46 @@ def _engine_snapshot(state: "_AppState") -> dict:
             "file": _fr.history_path() or "",
             "records": int(counters.get("history_records", 0)),
         },
+        "devices": _devices_section(),
+        "profile": _profile_section(),
     }
+
+
+def _devices_section() -> list:
+    """Per-local-device HBM rows (jax read directly — no profiler import,
+    so the disabled-profiler zero-import guarantee holds for /v1/engine)."""
+    rows = []
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return rows
+    for d in devices:
+        try:
+            mem = d.memory_stats() or {}
+        except Exception:
+            mem = {}
+        rows.append({
+            "id": int(getattr(d, "id", len(rows))),
+            "platform": str(getattr(d, "platform", "")),
+            "kind": str(getattr(d, "device_kind", "")),
+            "bytesInUse": int(mem.get("bytes_in_use", 0) or 0),
+            "peakBytesInUse": int(mem.get("peak_bytes_in_use", 0) or 0),
+            "bytesLimit": int(mem.get("bytes_limit", 0) or 0),
+        })
+    return rows
+
+
+def _profile_section() -> dict:
+    """The device profiler's own stats — imported ONLY when armed."""
+    if os.environ.get("DSQL_PROFILE", "0").strip() in ("", "0"):
+        return {"enabled": False}
+    try:
+        from ..runtime import profiler as _prof
+        return _prof.engine_section()
+    except Exception as e:
+        logger.debug("profiler section unavailable: %s", e)
+        return {"enabled": False}
 
 
 # ---------------------------------------------------------------------------

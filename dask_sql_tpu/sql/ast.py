@@ -404,4 +404,8 @@ class ExplainStatement(Statement):
     # EXPLAIN ANALYZE: execute the query (instrumented per plan node) and
     # annotate the rendered tree with measured wall-time + row counts
     analyze: bool = False
+    # EXPLAIN PROFILE: execute the query through the NORMAL engine path
+    # and render the device-level profile (per-stage flops/bytes/ms,
+    # per-device HBM, shard skew, collective bytes) captured on its spans
+    profile: bool = False
     pos: Tuple[int, int] = (0, 0)

@@ -161,8 +161,11 @@ class Parser:
             if u == "EXPLAIN":
                 self.i += 1
                 analyze = bool(self.eat_kw("ANALYZE"))
+                profile = (False if analyze
+                           else bool(self.eat_kw("PROFILE")))
                 return ExplainStatement(query=self.parse_query(),
-                                        analyze=analyze, pos=(t.line, t.col))
+                                        analyze=analyze, profile=profile,
+                                        pos=(t.line, t.col))
         if t.kind == "IDENT" and t.upper in ("SELECT", "WITH", "VALUES") or self.at_op("("):
             return QueryStatement(query=self.parse_query())
         self.error("Expected a SQL statement")
@@ -1155,11 +1158,12 @@ def _number_value(text: str):
 
 import re as _re
 
-# EXPLAIN ANALYZE is a Python-parser-only extension for now: the native
-# C++ grammar predates it and would report a parse error at ANALYZE, so
-# such statements route directly to the Python parser (which stays the
-# lockstep superset) instead of bouncing off a native error.
-_EXPLAIN_ANALYZE_RE = _re.compile(r"^\s*EXPLAIN\s+ANALYZE\b",
+# EXPLAIN ANALYZE / EXPLAIN PROFILE are Python-parser-only extensions for
+# now: the native C++ grammar predates them and would report a parse error
+# at the modifier keyword, so such statements route directly to the Python
+# parser (which stays the lockstep superset) instead of bouncing off a
+# native error.
+_EXPLAIN_ANALYZE_RE = _re.compile(r"^\s*EXPLAIN\s+(ANALYZE|PROFILE)\b",
                                   _re.IGNORECASE)
 
 

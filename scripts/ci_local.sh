@@ -85,6 +85,20 @@ echo "=== [2j] out-of-core smoke (spill manager + grace-hash joins) ==="
 # DSQL_SPILL_MB=0 restores the pre-spill StreamingUnsupported baseline
 python scripts/ooc_smoke.py
 
+echo "=== [2k] profile smoke (device-level query profiler) ==="
+# EXPLAIN PROFILE over the 8-device mesh must render nonzero per-stage
+# XLA cost, per-device HBM rows, sane shard skew and collective bytes by
+# kind; the cost-model estimate rung must close; DSQL_PROFILE=0 must
+# never even import the profiler
+python scripts/profile_smoke.py
+
+echo "=== [2l] perf sentinel (bench regression gate) ==="
+# the committed bench trajectory must sit inside the tolerance bands of
+# the published baseline, and the sentinel must prove it still catches a
+# doctored 2x regression
+python scripts/perf_sentinel.py
+python scripts/perf_sentinel.py --self-test
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \

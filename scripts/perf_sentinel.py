@@ -1,0 +1,324 @@
+#!/usr/bin/env python3
+"""Perf sentinel: diff a bench metrics JSON against the committed baseline.
+
+ISSUE 13 satellite: the BENCH_r*.json trajectory is the repo's perf
+memory, but nothing *reads* it in CI — a regression only surfaces when a
+human eyeballs the artifacts.  This script closes that loop:
+
+  1. pick a CURRENT metrics doc (``--current``, else the newest usable
+     ``BENCH_r*.json`` in the repo root);
+  2. pick a BASELINE (``--baseline``, else ``BASELINE.json``'s
+     ``published`` headline when non-empty, else the newest usable
+     ``BENCH_r*.json`` older than the current one);
+  3. normalize both to the schema-versioned ``headline`` block bench.py
+     emits (``schema``/``first_arrival_sec``/``program_store_hit_rate``/
+     ``vs_pandas_geomean``/``warm_exec_geomean_sec``/``compile_errors``),
+     deriving it from ``detail`` for pre-headline artifacts;
+  4. compare every metric present on BOTH sides with a direction-aware
+     tolerance band (``DSQL_SENTINEL_TOL``, default 0.25): lower-better
+     metrics may not grow past base*(1+tol), higher-better may not fall
+     below base*(1-tol), and ``compile_errors`` may never increase.
+
+Exit 0 = within bands (or nothing comparable — a warning, not a failure:
+old artifacts are sparse).  Exit 1 = regression, with a per-metric
+verdict table on stdout.  ``--self-test`` doctors a 2x regression into a
+copy of the current headline and asserts the comparison catches it.
+
+Importable: ``extract_headline``/``compare``/``run`` are pure functions
+used by tests/unit/test_profiler.py.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+SENTINEL_SCHEMA = 1
+HEADLINE_SCHEMA = 1
+
+# direction of "better" per headline metric; anything not listed is
+# reported but never judged
+LOWER_BETTER = ("warm_exec_geomean_sec", "first_arrival_sec")
+HIGHER_BETTER = ("program_store_hit_rate", "vs_pandas_geomean")
+NO_INCREASE = ("compile_errors",)
+
+# the wall-clock metric name bench.py has emitted since PR 6; artifacts
+# with a different ``metric`` (r01's rows/sec era) contribute no
+# warm_exec number
+_WALL_METRIC = "tpch_q1_q22_geomean_wall"
+
+
+def default_tolerance() -> float:
+    try:
+        raw = os.environ.get("DSQL_SENTINEL_TOL", "")
+        return float(raw) if raw else 0.25
+    except ValueError:
+        return 0.25
+
+
+def _geomean(vals) -> Optional[float]:
+    vals = [float(v) for v in vals if v and float(v) > 0]
+    if not vals:
+        return None
+    return math.exp(sum(math.log(v) for v in vals) / len(vals))
+
+
+def _unwrap(doc: dict) -> Optional[dict]:
+    """The metrics object itself: bench artifacts wrap it in
+    ``{"n":..,"cmd":..,"parsed":{...}}``; bench_result.json is bare."""
+    if not isinstance(doc, dict):
+        return None
+    if isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    if "metric" in doc or "headline" in doc or "detail" in doc:
+        return doc
+    return None
+
+
+def extract_headline(doc: dict) -> Optional[Dict[str, object]]:
+    """Normalize any bench artifact (wrapped or bare, pre- or
+    post-headline) to the headline block.  None when nothing usable."""
+    obj = _unwrap(doc)
+    if obj is None:
+        return None
+    hl = obj.get("headline")
+    if isinstance(hl, dict):
+        out = dict(hl)
+        out.setdefault("schema", HEADLINE_SCHEMA)
+        return out
+    # pre-headline artifact: derive from detail
+    det = obj.get("detail") or {}
+    if not isinstance(det, dict):
+        det = {}
+    out: Dict[str, object] = {"schema": HEADLINE_SCHEMA}
+    value = obj.get("value")
+    out["warm_exec_geomean_sec"] = (
+        float(value) if obj.get("metric") == _WALL_METRIC
+        and isinstance(value, (int, float)) and value > 0 else None)
+    fa = det.get("first_arrival_sec")
+    out["first_arrival_sec"] = (_geomean(fa.values())
+                                if isinstance(fa, dict) else None)
+    out["program_store_hit_rate"] = det.get("program_store_hit_rate")
+    # detail.vs_pandas_geomean is the same number as top-level
+    # vs_baseline (bench.py keeps both); accept either
+    vsp = det.get("vs_pandas_geomean")
+    if vsp is None:
+        vb = obj.get("vs_baseline")
+        vsp = float(vb) if isinstance(vb, (int, float)) and vb > 0 else None
+    out["vs_pandas_geomean"] = vsp
+    cs = det.get("compiled_stats") or {}
+    ce = cs.get("compile_errors") if isinstance(cs, dict) else None
+    out["compile_errors"] = int(ce) if ce is not None else None
+    if all(out[k] is None for k in
+           LOWER_BETTER + HIGHER_BETTER + NO_INCREASE):
+        return None
+    return out
+
+
+def compare(baseline: Dict[str, object], current: Dict[str, object],
+            tol: float) -> Tuple[List[dict], List[dict]]:
+    """(regressions, verdicts): every metric present and non-None on both
+    sides gets a verdict row; rows breaching their band also land in
+    regressions."""
+    regressions: List[dict] = []
+    verdicts: List[dict] = []
+    for key in LOWER_BETTER + HIGHER_BETTER + NO_INCREASE:
+        b, c = baseline.get(key), current.get(key)
+        if b is None or c is None:
+            continue
+        b, c = float(b), float(c)
+        row = {"metric": key, "baseline": b, "current": c}
+        if key in NO_INCREASE:
+            row["band"] = f"<= {b:g}"
+            row["ok"] = c <= b
+        elif key in LOWER_BETTER:
+            limit = b * (1.0 + tol)
+            row["band"] = f"<= {limit:.4g}"
+            row["ok"] = c <= limit
+        else:
+            limit = b * (1.0 - tol)
+            row["band"] = f">= {limit:.4g}"
+            row["ok"] = c >= limit
+        verdicts.append(row)
+        if not row["ok"]:
+            regressions.append(row)
+    return regressions, verdicts
+
+
+def _bench_files(root: str) -> List[str]:
+    """BENCH_r*.json in run order (r01, r02, ... — lexicographic works
+    for the zero-padded names; fall back to numeric sort)."""
+    files = glob.glob(os.path.join(root, "BENCH_r*.json"))
+
+    def keyfn(p):
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(p))
+        return int(m.group(1)) if m else 0
+
+    return sorted(files, key=keyfn)
+
+
+def _load(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def resolve_inputs(root: str, current_path: Optional[str],
+                   baseline_path: Optional[str]
+                   ) -> Tuple[Optional[dict], str, Optional[dict], str]:
+    """(current_headline, current_label, baseline_headline,
+    baseline_label) per the precedence in the module docstring."""
+    usable: List[Tuple[str, dict]] = []
+    for p in _bench_files(root):
+        doc = _load(p)
+        hl = extract_headline(doc) if doc else None
+        if hl is not None:
+            usable.append((p, hl))
+
+    cur_hl, cur_label = None, "(none)"
+    if current_path:
+        cur_hl = extract_headline(_load(current_path) or {})
+        cur_label = current_path
+    elif usable:
+        cur_label, cur_hl = usable[-1]
+        usable = usable[:-1]
+    elif current_path is None:
+        pass
+
+    base_hl, base_label = None, "(none)"
+    if baseline_path:
+        base_hl = extract_headline(_load(baseline_path) or {})
+        base_label = baseline_path
+    else:
+        bl = _load(os.path.join(root, "BASELINE.json")) or {}
+        published = bl.get("published")
+        if isinstance(published, dict) and published:
+            base_hl = extract_headline({"headline": published}) \
+                or extract_headline(published)
+            base_label = "BASELINE.json:published"
+        if base_hl is None and usable:
+            # newest usable artifact older than the current one
+            base_label, base_hl = usable[-1]
+    return cur_hl, cur_label, base_hl, base_label
+
+
+def run(root: str, current_path: Optional[str] = None,
+        baseline_path: Optional[str] = None,
+        tol: Optional[float] = None) -> Tuple[int, dict]:
+    """(exit_code, report).  0 = pass (or nothing comparable), 1 =
+    regression, 2 = requested input unreadable."""
+    tol = default_tolerance() if tol is None else tol
+    cur, cur_label, base, base_label = resolve_inputs(
+        root, current_path, baseline_path)
+    report = {"sentinel_schema": SENTINEL_SCHEMA, "tolerance": tol,
+              "current": cur_label, "baseline": base_label,
+              "current_headline": cur, "baseline_headline": base,
+              "verdicts": [], "regressions": [], "status": "pass"}
+    if current_path and cur is None:
+        report["status"] = "error: current metrics unreadable"
+        return 2, report
+    if baseline_path and base is None:
+        report["status"] = "error: baseline metrics unreadable"
+        return 2, report
+    if cur is None or base is None:
+        report["status"] = "pass (nothing comparable)"
+        return 0, report
+    regressions, verdicts = compare(base, cur, tol)
+    report["verdicts"] = verdicts
+    report["regressions"] = regressions
+    if not verdicts:
+        report["status"] = "pass (no shared metrics)"
+    elif regressions:
+        report["status"] = "REGRESSION"
+        return 1, report
+    return 0, report
+
+
+def _render(report: dict) -> str:
+    lines = [f"perf_sentinel schema={report['sentinel_schema']} "
+             f"tol={report['tolerance']:g}",
+             f"  baseline: {report['baseline']}",
+             f"  current:  {report['current']}"]
+    for row in report["verdicts"]:
+        mark = "ok  " if row["ok"] else "FAIL"
+        lines.append(f"  [{mark}] {row['metric']}: "
+                     f"{row['baseline']:g} -> {row['current']:g} "
+                     f"(band {row['band']})")
+    lines.append(f"  status: {report['status']}")
+    return "\n".join(lines)
+
+
+def self_test(root: str) -> int:
+    """Doctor a 2x regression into the current headline and assert the
+    comparison catches it (and that an identical headline passes)."""
+    cur, label, _, _ = resolve_inputs(root, None, None)
+    if cur is None or all(cur.get(k) is None for k in LOWER_BETTER):
+        # no wall-clock artifact to doctor: use a synthetic one so the
+        # self-test still exercises the comparator
+        cur, label = {"schema": HEADLINE_SCHEMA,
+                      "warm_exec_geomean_sec": 1.0,
+                      "first_arrival_sec": 2.0,
+                      "program_store_hit_rate": 0.9,
+                      "vs_pandas_geomean": 1.5,
+                      "compile_errors": 0}, "(synthetic)"
+    same, _ = compare(cur, dict(cur), default_tolerance())
+    if same:
+        print(f"self-test FAIL: identical headline flagged ({same})")
+        return 1
+    doctored = dict(cur)
+    hit = False
+    for k in LOWER_BETTER:
+        if doctored.get(k) is not None:
+            doctored[k] = float(doctored[k]) * 2.0
+            hit = True
+    for k in HIGHER_BETTER:
+        if doctored.get(k) is not None:
+            doctored[k] = float(doctored[k]) / 2.0
+            hit = True
+    if not hit:
+        print("self-test FAIL: headline has no doctorable metric")
+        return 1
+    regressions, _ = compare(cur, doctored, default_tolerance())
+    if not regressions:
+        print("self-test FAIL: 2x regression not flagged")
+        return 1
+    print(f"self-test ok: 2x regression on {label} flagged "
+          f"{len(regressions)} metric(s)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--current", help="metrics JSON to judge (default: "
+                    "newest usable BENCH_r*.json)")
+    ap.add_argument("--baseline", help="metrics JSON to judge against "
+                    "(default: BASELINE.json published headline, else "
+                    "the previous usable BENCH_r*.json)")
+    ap.add_argument("--tol", type=float, default=None,
+                    help="relative tolerance band (default: "
+                    "DSQL_SENTINEL_TOL or 0.25)")
+    ap.add_argument("--root", default=None,
+                    help="repo root holding BENCH_r*.json/BASELINE.json")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON instead of text")
+    ap.add_argument("--self-test", action="store_true",
+                    help="inject a 2x regression and assert it is caught")
+    args = ap.parse_args(argv)
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    if args.self_test:
+        return self_test(root)
+    code, report = run(root, args.current, args.baseline, args.tol)
+    print(json.dumps(report) if args.json else _render(report))
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

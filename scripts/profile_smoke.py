@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Device-profiler smoke gate: EXPLAIN PROFILE must measure real queries.
+
+Run by scripts/ci_local.sh (mirroring scripts/obs_smoke.py):
+
+    python scripts/profile_smoke.py
+
+TPC-H queries run on the 8-virtual-device CPU mesh with the profiler
+armed (``DSQL_PROFILE=1``); the gate asserts
+
+  1. ``EXPLAIN PROFILE`` renders per-stage XLA cost (nonzero flops +
+     bytes), one HBM row per device (8), and — on the join query — the
+     collective-bytes line split by kind and a sane shard-skew ratio;
+  2. the cost-model estimate rung closes: a repeat run with the stats
+     rung off and a FRESH history file reserves from the captured XLA
+     cost (envelope journals ``est_source="cost_model"``);
+  3. ``system.devices`` answers through plain SQL with one row per
+     device;
+  4. ``GET /v1/engine`` carries the ``devices`` and ``profile``
+     sections;
+  5. the flight-recorder envelope carries the new skew / collective /
+     cost-error fields;
+  6. the disabled path is ZERO-cost: a child process with
+     ``DSQL_PROFILE=0`` never imports the profiler module and
+     ``EXPLAIN PROFILE`` prints the pointer line without executing.
+
+Exit 0 on success.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = \
+        (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+os.environ["DSQL_PROFILE"] = "1"
+# synchronous compiles (the cost capture rides the compile) and no stats
+# rung (it outranks the cost-model rung this gate must prove out)
+os.environ.setdefault("DSQL_TIERED", "0")
+os.environ["DSQL_ADAPTIVE"] = "0"
+os.environ.pop("DSQL_HISTORY_FILE", None)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from benchmarks.tpch import QUERIES, generate_tpch  # noqa: E402
+from dask_sql_tpu import Context  # noqa: E402
+from dask_sql_tpu.parallel.mesh import default_mesh  # noqa: E402
+from dask_sql_tpu.runtime import telemetry as tel  # noqa: E402
+
+SUBSET = (1, 3, 6)   # agg-heavy, join+agg+topk, scan/filter
+SF = 0.002
+N_DEV = 8
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _profile_lines(ctx, sql):
+    out = ctx.sql("EXPLAIN PROFILE " + sql, return_futures=False)
+    return [str(l) for l in out["PLAN"]]
+
+
+def main() -> int:
+    mesh = default_mesh()
+    if int(mesh.devices.size) != N_DEV:
+        return fail(f"expected {N_DEV}-device mesh, got {mesh.devices.size}")
+    data = generate_tpch(SF)
+    ctx = Context(mesh=mesh)
+    for name, df in data.items():
+        ctx.create_table(name, df)
+
+    # -- 1. EXPLAIN PROFILE over the mesh ------------------------------------
+    flops_re = re.compile(r"flops=([0-9.]+)")
+    for qid in SUBSET:
+        lines = _profile_lines(ctx, QUERIES[qid])
+        stage_lines = [l for l in lines if l.startswith("-- stage")]
+        if not stage_lines:
+            return fail(f"q{qid}: no per-stage profile rows:\n"
+                        + "\n".join(lines))
+        flops = [float(m.group(1)) for l in stage_lines
+                 for m in [flops_re.search(l)] if m]
+        if not flops or sum(flops) <= 0:
+            return fail(f"q{qid}: no nonzero flops in {stage_lines}")
+        dev_rows = [l for l in lines if l.startswith("-- device")]
+        if len(dev_rows) != N_DEV:
+            return fail(f"q{qid}: {len(dev_rows)} device rows, "
+                        f"want {N_DEV}")
+        skews = [float(m.group(1)) for l in lines
+                 for m in [re.search(r"skew_ratio: ([0-9.]+)", l)] if m]
+        if any(s < 1.0 or s > N_DEV + 0.5 for s in skews):
+            return fail(f"q{qid}: insane skew ratio {skews}")
+        print(f"ok q{qid}: {len(stage_lines)} stage row(s) "
+              f"flops={sum(flops):.0f} devices={len(dev_rows)} "
+              f"skew={skews or 'n/a'}")
+    q3_lines = _profile_lines(ctx, QUERIES[3])
+    coll = [l for l in q3_lines if l.startswith("-- collectives")]
+    if not coll or not re.search(r"(all_gather|all_to_all)=[1-9]", coll[0]):
+        return fail(f"q3: no collective bytes by kind: {coll}")
+    print(f"ok collectives: {coll[0][3:].strip()}")
+
+    # -- 2. cost-model estimate rung -----------------------------------------
+    solo = Context()
+    solo.create_table("pt", {"a": list(range(2000)),
+                             "b": [i % 11 for i in range(2000)]})
+    q = "SELECT b, SUM(a) AS s FROM pt GROUP BY b"
+    solo.sql(q, return_futures=False)   # run 1: cost ledger fills at compile
+    before = tel.REGISTRY.get("estimate_from_cost_model")
+    hist = os.path.join(tempfile.mkdtemp(prefix="dsql_prof_"),
+                        "history.jsonl")
+    os.environ["DSQL_HISTORY_FILE"] = hist  # fresh: history rung misses
+    try:
+        solo.sql(q, return_futures=False)
+        if tel.REGISTRY.get("estimate_from_cost_model") <= before:
+            return fail("estimate_from_cost_model did not advance")
+        from dask_sql_tpu.runtime import flight_recorder as fr
+        ev = fr.read_events(kind="query")[-1]
+        if ev.get("est_source") != "cost_model":
+            return fail(f"repeat run estimated from "
+                        f"{ev.get('est_source')!r}, not cost_model")
+        # -- 5. envelope carries the new fields -------------------------------
+        for key in ("skew_ratio", "collective_bytes", "cost_err"):
+            if key not in ev:
+                return fail(f"envelope missing {key!r}: {sorted(ev)}")
+        print(f"ok cost-model rung: est={ev['est_bytes']}B "
+              f"cost_err={ev['cost_err']}")
+    finally:
+        del os.environ["DSQL_HISTORY_FILE"]
+
+    # -- 3. system.devices through SQL ---------------------------------------
+    dev = ctx.sql("SELECT device_id, platform, bytes_in_use "
+                  "FROM system.devices", return_futures=False)
+    if len(dev) != N_DEV:
+        return fail(f"system.devices has {len(dev)} rows, want {N_DEV}")
+    print(f"ok system.devices: {len(dev)} rows")
+
+    # -- 4. /v1/engine sections ----------------------------------------------
+    srv = ctx.run_server(host="127.0.0.1", port=0, blocking=False)
+    try:
+        base = f"http://127.0.0.1:{srv.server_port}"
+        with urllib.request.urlopen(f"{base}/v1/engine") as r:
+            snap = json.loads(r.read())
+        if len(snap.get("devices", [])) != N_DEV:
+            return fail(f"/v1/engine devices: {snap.get('devices')}")
+        prof = snap.get("profile", {})
+        if not prof.get("enabled") or prof.get("samples", 0) < 1:
+            return fail(f"/v1/engine profile section dead: {prof}")
+        print(f"ok /v1/engine: devices={len(snap['devices'])} "
+              f"profile samples={prof['samples']}")
+    finally:
+        srv.shutdown()
+        ctx.server = None
+
+    # -- 6. disabled path is zero-cost ---------------------------------------
+    child_code = (
+        "import sys\n"
+        "from dask_sql_tpu import Context\n"
+        "c = Context()\n"
+        "c.create_table('t', {'a': [1, 2, 3, 4]})\n"
+        "c.sql('SELECT SUM(a) AS s FROM t', return_futures=False)\n"
+        "out = c.sql('EXPLAIN PROFILE SELECT SUM(a) AS s FROM t',\n"
+        "            return_futures=False)\n"
+        "lines = [str(l) for l in out['PLAN']]\n"
+        "assert 'dask_sql_tpu.runtime.profiler' not in sys.modules, \\\n"
+        "    'profiler imported with DSQL_PROFILE=0'\n"
+        "assert any('profile: disabled' in l for l in lines), lines\n"
+        "assert not any(l.startswith('-- stage') for l in lines), lines\n"
+        "print('child ok')\n"
+    )
+    env = dict(os.environ)
+    env["DSQL_PROFILE"] = "0"
+    env.pop("XLA_FLAGS", None)   # single device is fine (and faster)
+    proc = subprocess.run([sys.executable, "-c", child_code], env=env,
+                          capture_output=True, timeout=600)
+    if proc.returncode != 0 or b"child ok" not in proc.stdout:
+        return fail(f"disabled-path child: {proc.stderr.decode()[-500:]}")
+    print("ok disabled path: zero profiler imports, no execution")
+
+    print("profile smoke PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -166,6 +166,20 @@ def _adaptive_off(request, monkeypatch):
 
 
 @pytest.fixture(autouse=True)
+def _profiler_off(request, monkeypatch):
+    """The device profiler (runtime/profiler.py) is env-armed like the
+    flight recorder; an operator's DSQL_PROFILE must not arm per-device
+    sampling, forced AOT compiles and cost capture in unrelated suites
+    (or break the zero-import tripwire test).  Off by default, armed
+    explicitly by the dedicated profiler suites, and
+    scripts/profile_smoke.py gates the production path."""
+    if "profile" not in request.module.__name__:
+        monkeypatch.delenv("DSQL_PROFILE", raising=False)
+        monkeypatch.delenv("DSQL_PROFILE_SAMPLE_MS", raising=False)
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _mesh_off(request, monkeypatch):
     """The SPMD multi-chip backend (parallel/spmd.py, on by default when a
     context carries a mesh) intercepts mesh-context queries before the
