@@ -82,14 +82,27 @@ class Context:
         since this Context was created."""
         return self._table_epochs.get((schema_name, table_name.lower()), 0)
 
-    def bump_table_epoch(self, schema_name: str, table_name: str) -> int:
+    def bump_table_epoch(self, schema_name: str, table_name: str,
+                         delta: Optional[Table] = None) -> int:
         """Advance the table's epoch (every mutating path calls this) and
-        drop any cached results that reference it."""
+        drop any cached results that reference it.
+
+        ``delta``: the appended batch, when the mutation is a pure append
+        (``append_rows`` / INSERT INTO).  Recorded on the materialized-view
+        registry so dependent maintainable views refresh in O(delta);
+        omitted (every other caller) the bump is a hard tombstone — the
+        delta log clears and dependents recompute in full."""
         key = (schema_name, table_name.lower())
         epoch = next(self._epoch_counter)
         self._table_epochs[key] = epoch
         from .runtime import result_cache as _rc
         _rc.get_cache().invalidate_table(schema_name, table_name.lower())
+        reg = self.__dict__.get("_matview_registry")
+        if reg is not None:
+            if delta is not None:
+                reg.record_delta(key, epoch, delta)
+            else:
+                reg.record_overwrite(key, epoch)
         return epoch
 
     # ------------------------------------------------------------- schemas
@@ -99,6 +112,9 @@ class Context:
     def drop_schema(self, schema_name: str):
         if schema_name == self.DEFAULT_SCHEMA_NAME:
             raise RuntimeError(f"Default schema {schema_name} cannot be deleted")
+        reg = self.__dict__.get("_matview_registry")
+        if reg is not None:
+            reg.discard_schema(schema_name)
         for table_name in list(self.schema[schema_name].tables):
             self.bump_table_epoch(schema_name, table_name)
         del self.schema[schema_name]
@@ -125,6 +141,11 @@ class Context:
         Accepts a pandas frame or a parquet path.
         """
         schema_name = schema_name or self.schema_name
+        reg = self.__dict__.get("_matview_registry")
+        if reg is not None:
+            # re-registering a name that was a materialized view is an
+            # overwrite: the registry entry goes, the bump below tombstones
+            reg.discard_view(schema_name, table_name.lower())
         if chunked:
             # composes with mesh= : the streaming executor row-shards each
             # uploaded batch over the mesh (physical/streaming.py
@@ -178,10 +199,23 @@ class Context:
 
     def drop_table(self, table_name: str, schema_name: Optional[str] = None):
         schema_name = schema_name or self.schema_name
+        reg = self.__dict__.get("_matview_registry")
+        if reg is not None:
+            # DROP TABLE on a materialized view tears down its registry
+            # state too (maintained cache entry, delta pins)
+            reg.discard_view(schema_name, table_name.lower())
         del self.schema[schema_name].tables[table_name.lower()]
         self.bump_table_epoch(schema_name, table_name)
 
     def alter_schema(self, old_schema_name, new_schema_name):
+        reg = self.__dict__.get("_matview_registry")
+        if reg is not None:
+            # renames re-key the catalog under the views' feet: registered
+            # views (old or new schema) and views over tables in either are
+            # invalidated by the tombstone bumps below; drop the registry
+            # entries so stale maintained state cannot survive the rename
+            reg.discard_schema(old_schema_name)
+            reg.discard_schema(new_schema_name)
         self.schema[new_schema_name] = self.schema.pop(old_schema_name)
         for table_name in list(self.schema[new_schema_name].tables):
             self.bump_table_epoch(old_schema_name, table_name)
@@ -189,10 +223,84 @@ class Context:
 
     def alter_table(self, old_table_name, new_table_name, schema_name=None):
         schema_name = schema_name or self.schema_name
+        reg = self.__dict__.get("_matview_registry")
+        if reg is not None:
+            reg.discard_view(schema_name, old_table_name.lower())
+            reg.discard_view(schema_name, new_table_name.lower())
         s = self.schema[schema_name]
         s.tables[new_table_name.lower()] = s.tables.pop(old_table_name.lower())
         self.bump_table_epoch(schema_name, old_table_name)
         self.bump_table_epoch(schema_name, new_table_name)
+
+    def append_rows(self, table_name: str, rows: Any,
+                    schema_name: Optional[str] = None) -> int:
+        """Append ``rows`` to a registered resident table — the delta path
+        (ISSUE 14): unlike re-``create_table``, the epoch bump carries the
+        appended batch, so materialized views over the table refresh in
+        O(delta) instead of recomputing (runtime/matview.py).
+
+        ``rows``: a device ``Table``, pandas DataFrame, dict of columns, or
+        list of row tuples (matched positionally).  Columns align to the
+        target case-insensitively (or positionally when the names do not
+        match), values cast to the target column types.  Returns the number
+        of rows appended.  ``INSERT INTO`` lowers to this.
+        """
+        from .ops.join import concat_tables
+        from .runtime.resilience import UserError
+        from .runtime.statistics import collect_table_stats
+
+        schema_name = schema_name or self.schema_name
+        entry = self.schema[schema_name].tables.get(table_name.lower())
+        if entry is None:
+            raise UserError(f"Table {table_name} not found in schema "
+                            f"{schema_name}; create it before INSERT INTO.")
+        if entry.chunked is not None:
+            raise UserError(
+                f"Table {table_name} is chunked (host-resident batches); "
+                "appends are not supported — re-create it from the extended "
+                "source instead.")
+        if entry.table is None:
+            raise UserError(
+                f"{table_name} is a view; INSERT INTO targets tables. "
+                "Append to its base tables instead.")
+        reg = self.__dict__.get("_matview_registry")
+        if reg is not None and (schema_name, table_name.lower()) in \
+                getattr(reg, "views", {}):
+            raise UserError(
+                f"{table_name} is a materialized view; INSERT INTO targets "
+                "base tables — the view refreshes from their appends.")
+        delta = _coerce_delta(entry.table, rows)
+        if delta.num_rows == 0:
+            return 0
+        if self.mesh is not None:
+            # sharded base: concat on host against the valid prefix, then
+            # re-shard — appends are rare relative to scans, so the round
+            # trip beats keeping a resharding kernel alive
+            import numpy as np
+            import pandas as pd
+            from .parallel.mesh import shard_table_with_validity
+            base_df = entry.table.to_pandas()
+            if entry.row_valid is not None:
+                base_df = base_df.iloc[
+                    :int(np.asarray(entry.row_valid).sum())]
+            combined = pd.concat([base_df, delta.to_pandas()],
+                                 ignore_index=True)
+            new_table = _coerce_delta(entry.table,
+                                      Table.from_pandas(combined))
+            new_table, row_valid = shard_table_with_validity(new_table,
+                                                             self.mesh)
+        else:
+            new_table = concat_tables([entry.table, delta])
+            row_valid = None
+        stats = collect_table_stats(new_table, row_valid=row_valid)
+        self.schema[schema_name].tables[table_name.lower()] = TableEntry(
+            table=new_table, statistics=entry.statistics,
+            filepath=entry.filepath, gpu=entry.gpu, row_valid=row_valid,
+            stats=stats)
+        self.bump_table_epoch(schema_name, table_name, delta=delta)
+        logger.debug("Appended %d rows to %s.%s (now %d)", delta.num_rows,
+                     schema_name, table_name, new_table.num_rows)
+        return delta.num_rows
 
     # ------------------------------------------------------------ functions
     def register_function(self, f: Callable, name: str,
@@ -521,6 +629,14 @@ class Context:
             if entry is None:
                 entry = schema.tables.get(table_name)
             if entry is not None:
+                # materialized-view serve hook (runtime/matview.py): a view
+                # whose base tables advanced refreshes HERE, before the scan
+                # binds — stale maintained state is never served.  getattr
+                # keeps the common no-MV path allocation-free.
+                reg = self.__dict__.get("_matview_registry")
+                if reg is not None:
+                    entry = reg.maybe_serve(self, schema_name,
+                                            table_name.lower(), entry)
                 if entry.table is not None:
                     fields = [Field(n, c.stype) for n, c in
                               zip(entry.table.names, entry.table.columns)]
@@ -587,6 +703,47 @@ class Context:
         if self.server is not None:
             self.server.shutdown()
             self.server = None
+
+
+def _coerce_delta(target: Table, rows: Any) -> Table:
+    """Shape ``rows`` into a Table matching ``target``'s column names and
+    types (append_rows' alignment/cast step)."""
+    import pandas as pd
+
+    from .physical.rex.cast import cast_column
+    from .runtime.resilience import UserError
+
+    if isinstance(rows, Table):
+        df = rows.to_pandas()
+    elif isinstance(rows, pd.DataFrame):
+        df = rows
+    elif isinstance(rows, dict):
+        df = pd.DataFrame(rows)
+    elif isinstance(rows, (list, tuple)):
+        df = pd.DataFrame(list(rows), columns=list(target.names))
+    else:
+        raise UserError(
+            "append_rows accepts a Table, pandas DataFrame, dict of "
+            f"columns, or list of row tuples; got {type(rows).__name__}")
+    lower_map = {str(c).lower(): c for c in df.columns}
+    if all(n.lower() in lower_map for n in target.names) and \
+            len(df.columns) == len(target.names):
+        df = df[[lower_map[n.lower()] for n in target.names]]
+    elif len(df.columns) == len(target.names):
+        pass  # positional: trust the order
+    else:
+        raise UserError(
+            f"appended rows have columns {list(df.columns)} but the table "
+            f"has {list(target.names)}; supply every target column (by "
+            "name, any case, or positionally)")
+    df = df.set_axis(list(target.names), axis=1)
+    delta = Table.from_pandas(df)
+    cols = []
+    for col, tgt in zip(delta.columns, target.columns):
+        if col.stype.name != tgt.stype.name:
+            col = cast_column(col, tgt.stype)
+        cols.append(col)
+    return Table(list(target.names), cols)
 
 
 def _to_sql_type(t) -> SqlType:

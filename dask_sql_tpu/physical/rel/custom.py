@@ -92,6 +92,12 @@ def _create_table_as(stmt: A.CreateTableAs, context, sql):
         if not stmt.or_replace:
             raise RuntimeError(f"A table with the name {name} is already present.")
     plan = context._get_plan(stmt.query, sql)
+    # overwriting a materialized view with CREATE [OR REPLACE] TABLE/VIEW AS
+    # tears down its registry state — the replaced entry must never be
+    # refreshed back over the new definition
+    reg = context.__dict__.get("_matview_registry")
+    if reg is not None:
+        reg.discard_view(schema_name, name)
     if stmt.view:
         # views stay lazy: re-planned/executed per query (reference
         # CREATE VIEW = lazy dask graph, create_table_as.py:30-55)
@@ -102,6 +108,62 @@ def _create_table_as(stmt: A.CreateTableAs, context, sql):
     table = RelExecutor(context).execute(plan)
     context.schema[schema_name].tables[name] = TableEntry(table=table)
     context.bump_table_epoch(schema_name, name)
+    return None
+
+
+def _create_matview(stmt: A.CreateMaterializedView, context, sql):
+    from ...runtime import matview as _mv
+    _mv.create_matview(context, stmt.name, stmt.query, sql,
+                       if_not_exists=stmt.if_not_exists,
+                       or_replace=stmt.or_replace)
+    return None
+
+
+def _drop_matview(stmt: A.DropMaterializedView, context, sql):
+    from ...runtime import matview as _mv
+    _mv.drop_matview(context, stmt.name, if_exists=stmt.if_exists)
+    return None
+
+
+def _refresh_matview(stmt: A.RefreshMaterializedView, context, sql):
+    from ...runtime import matview as _mv
+    _mv.refresh_matview(context, stmt.name)
+    return None
+
+
+def _insert_into(stmt: A.InsertInto, context, sql):
+    """INSERT INTO: run the source query (VALUES lowers to a query too)
+    through the normal execution path, then hand the rows to
+    ``Context.append_rows`` — the delta-recording append seam."""
+    from ...runtime.resilience import UserError
+
+    plan = context._get_plan(stmt.query, sql)
+    rows = context._execute_query_plan(plan)
+    schema_name, name = context.fqn(stmt.table)
+    payload = rows
+    if stmt.columns is not None:
+        if len(stmt.columns) != rows.num_columns:
+            raise UserError(
+                f"INSERT INTO {name} names {len(stmt.columns)} columns but "
+                f"the source produces {rows.num_columns}.")
+        entry = context.schema[schema_name].tables.get(name)
+        if entry is not None and entry.table is not None:
+            import pandas as pd
+            df = rows.to_pandas()
+            df.columns = [c.lower() for c in stmt.columns]
+            target = list(entry.table.names)
+            unknown = [c for c in df.columns
+                       if c not in {t.lower() for t in target}]
+            if unknown:
+                raise UserError(
+                    f"INSERT INTO {name} names columns {unknown} that the "
+                    f"table does not have (columns: {target}).")
+            # unnamed target columns fill NULL
+            payload = pd.DataFrame(
+                {t: (df[t.lower()] if t.lower() in df.columns
+                     else pd.Series([None] * len(df)))
+                 for t in target})
+    context.append_rows(name, payload, schema_name=schema_name)
     return None
 
 
@@ -473,6 +535,10 @@ StatementDispatcher.add_plugin("UseSchema", _use_schema)
 StatementDispatcher.add_plugin("CreateTable", _create_table)
 StatementDispatcher.add_plugin("CreateTableAs", _create_table_as)
 StatementDispatcher.add_plugin("DropTable", _drop_table)
+StatementDispatcher.add_plugin("CreateMaterializedView", _create_matview)
+StatementDispatcher.add_plugin("DropMaterializedView", _drop_matview)
+StatementDispatcher.add_plugin("RefreshMaterializedView", _refresh_matview)
+StatementDispatcher.add_plugin("InsertInto", _insert_into)
 StatementDispatcher.add_plugin("ShowSchemas", _show_schemas)
 StatementDispatcher.add_plugin("ShowTables", _show_tables)
 StatementDispatcher.add_plugin("ShowColumns", _show_columns)

@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -174,6 +175,12 @@ def _run_resident(plan: RelNode, context) -> Table:
 
 
 _tmp_counter = [0]
+
+# execute_streaming serialization (see its docstring): one streaming query
+# at a time per process; depth per context id so only the outermost frame
+# of a same-thread nesting pops the temp schema
+_EXEC_LOCK = threading.RLock()
+_exec_depth: dict = {}
 
 
 def _register_temp(context, table: Table, row_valid=None) -> LogicalTableScan:
@@ -1032,12 +1039,28 @@ def _lower_chunked(plan: RelNode, context) -> RelNode:
 
 def execute_streaming(plan: RelNode, context) -> Table:
     """Lower a plan referencing chunked tables by iterative subtree
-    streaming, then run the rewritten (chunk-free) plan resident."""
-    try:
-        lowered = _lower_chunked(plan, context)
-        result = _run_resident(lowered, context)
-    finally:
-        _cleanup(context)
+    streaming, then run the rewritten (chunk-free) plan resident.
+
+    Serialized under a module lock: the executor stages temps and the
+    shared ``__batch__`` entry in the per-context ``__stream__`` schema,
+    and two interleaved queries would clobber each other's entries (the
+    loser dies on a KeyError mid-plan — or worse, reads the other
+    query's batch).  Streaming queries are whole-table scans fighting
+    for the same HBM anyway; serializing them costs little.  The depth
+    counter keeps a nested streaming execution (e.g. a lazy view's plan
+    executed mid-lowering on the same thread) from popping the outer
+    query's temps: only the outermost frame cleans up."""
+    with _EXEC_LOCK:
+        key = id(context)
+        _exec_depth[key] = _exec_depth.get(key, 0) + 1
+        try:
+            lowered = _lower_chunked(plan, context)
+            result = _run_resident(lowered, context)
+        finally:
+            _exec_depth[key] -= 1
+            if _exec_depth[key] == 0:
+                del _exec_depth[key]
+                _cleanup(context)
     # temp-table scans carry sanitized column names (c0, c1, ...); the
     # user-visible names are the plan root's schema, always
     return result.with_names([f.name for f in plan.schema])

@@ -29,7 +29,8 @@ import numpy as np
 from ..table import Table
 
 TABLE_NAMES = ("queries", "active", "metrics", "cache", "quarantine",
-               "programs", "table_stats", "mesh", "spill", "devices")
+               "programs", "table_stats", "mesh", "spill", "devices",
+               "matviews", "view_candidates")
 
 
 def _col(rows: List[dict], key: str, dtype, default):
@@ -321,6 +322,48 @@ def _spill() -> Table:
     })
 
 
+def _matviews(context=None) -> Table:
+    """One row per registered materialized view (runtime/matview.py):
+    maintainability verdict with the full-recompute reason, delta backlog,
+    and the serve/refresh counters the acceptance criteria reconcile."""
+    from . import matview as _mv
+
+    rows = _mv.matview_rows(context) if context is not None else []
+    return Table.from_pydict({
+        "schema": _col(rows, "schema", object, ""),
+        "name": _col(rows, "name", object, ""),
+        "rows": _col(rows, "rows", np.int64, 0),
+        "maintainable": _col(rows, "maintainable", object, ""),
+        "reason": _col(rows, "reason", object, ""),
+        "base_tables": _col(rows, "base_tables", object, ""),
+        "pending_deltas": _col(rows, "pending_deltas", np.int64, 0),
+        "serves": _col(rows, "serves", np.int64, 0),
+        "refresh_incremental": _col(rows, "refresh_incremental",
+                                    np.int64, 0),
+        "refresh_full": _col(rows, "refresh_full", np.int64, 0),
+        "last_refresh": _col(rows, "last_refresh", object, ""),
+        "fingerprint": _col(rows, "fingerprint", object, ""),
+    })
+
+
+def _view_candidates(context=None) -> Table:
+    """Hot repeated plan fingerprints from the flight recorder's EWMA
+    history ranked by hits x recompute cost — the operator's shortlist of
+    what to CREATE MATERIALIZED VIEW next.  Empty when the recorder
+    (DSQL_HISTORY_FILE) is off."""
+    from . import matview as _mv
+
+    rows = _mv.view_candidate_rows(context) if context is not None else []
+    return Table.from_pydict({
+        "fingerprint": _col(rows, "fingerprint", object, ""),
+        "hits": _col(rows, "hits", np.int64, 0),
+        "ewma_ms": _col(rows, "ewma_ms", np.float64, 0.0),
+        "score": _col(rows, "score", np.float64, 0.0),
+        "materialized": _col(rows, "materialized", np.bool_, False),
+        "example_sql": _col(rows, "example_sql", object, ""),
+    })
+
+
 _BUILDERS: Dict[str, object] = {
     "queries": _queries,
     "active": _active,
@@ -332,10 +375,12 @@ _BUILDERS: Dict[str, object] = {
     "mesh": _mesh,
     "spill": _spill,
     "devices": _devices,
+    "matviews": _matviews,
+    "view_candidates": _view_candidates,
 }
 
 #: builders that need the resolving context (catalog / mesh live there)
-_CONTEXT_BUILDERS = (_table_stats, _mesh)
+_CONTEXT_BUILDERS = (_table_stats, _mesh, _matviews, _view_candidates)
 
 
 def build(name: str, context=None) -> Optional[Table]:

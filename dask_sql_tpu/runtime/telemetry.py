@@ -146,6 +146,12 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     # program-store load), and scheduler estimates served from the
     # captured cost model (the ladder's fourth rung)
     "profile_samples", "profile_cost_captures", "estimate_from_cost_model",
+    # materialized views (runtime/matview.py): serves through the
+    # resolve_table hook, O(delta) vs full refreshes (incremental + full
+    # reconciles against the staleness events a soak drives), appended
+    # batches logged on the delta seam, and the refresh chaos site
+    "mv_serves", "mv_refresh_incremental", "mv_refresh_full",
+    "mv_deltas_recorded", "fault_mv_refresh",
 )
 
 STABLE_HISTOGRAMS: Tuple[str, ...] = (

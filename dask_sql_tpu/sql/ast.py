@@ -302,6 +302,42 @@ class DropTable(Statement):
 
 
 @dataclass
+class CreateMaterializedView(Statement):
+    """CREATE [OR REPLACE] MATERIALIZED VIEW [IF NOT EXISTS] name AS (query)
+
+    Unlike the lazy CREATE VIEW, the result is materialized eagerly and kept
+    incrementally fresh against base-table appends (runtime/matview.py)."""
+    name: List[str] = field(default_factory=list)
+    query: SelectLike = None
+    if_not_exists: bool = False
+    or_replace: bool = False
+    pos: Tuple[int, int] = (0, 0)
+
+
+@dataclass
+class DropMaterializedView(Statement):
+    name: List[str] = field(default_factory=list)
+    if_exists: bool = False
+    pos: Tuple[int, int] = (0, 0)
+
+
+@dataclass
+class RefreshMaterializedView(Statement):
+    name: List[str] = field(default_factory=list)
+    pos: Tuple[int, int] = (0, 0)
+
+
+@dataclass
+class InsertInto(Statement):
+    """INSERT INTO t [(col, ...)] VALUES (...) | <query> — the append path:
+    rows land as a delta record on the table's epoch, not a bare tombstone."""
+    table: List[str] = field(default_factory=list)
+    columns: Optional[List[str]] = None
+    query: SelectLike = None
+    pos: Tuple[int, int] = (0, 0)
+
+
+@dataclass
 class CreateSchema(Statement):
     name: str = ""
     if_not_exists: bool = False

@@ -19,11 +19,12 @@ from ..utils import ParsingException
 from .ast import *  # noqa: F401,F403
 from .ast import (
     AnalyzeTable, Between, Call, Case, Cast, ColumnRef, CreateExperiment,
-    CreateModel, CreateSchema, CreateTable, CreateTableAs, DescribeModel,
-    DescribeTable, DropModel, DropSchema, DropTable, ExplainStatement,
-    ExportModel, Expr, InList, IntervalLiteral, IsBool, IsDistinctFrom,
-    IsNull, JoinRelation, Like, Literal, Param, PredictRelation,
-    QueryStatement, Relation, Select, SelectLike, SetOp, ShowColumns,
+    CreateMaterializedView, CreateModel, CreateSchema, CreateTable,
+    CreateTableAs, DescribeModel, DescribeTable, DropMaterializedView,
+    DropModel, DropSchema, DropTable, ExplainStatement, ExportModel, Expr,
+    InList, InsertInto, IntervalLiteral, IsBool, IsDistinctFrom, IsNull,
+    JoinRelation, Like, Literal, Param, PredictRelation, QueryStatement,
+    RefreshMaterializedView, Relation, Select, SelectLike, SetOp, ShowColumns,
     ShowModels, ShowSchemas, ShowTables, SortKey, Star, Statement, Subquery,
     SubqueryRelation, TableRef, UseSchema, ValuesQuery, WindowSpec,
 )
@@ -158,6 +159,10 @@ class Parser:
                 return self._parse_use()
             if u == "EXPORT":
                 return self._parse_export()
+            if u == "INSERT":
+                return self._parse_insert()
+            if u == "REFRESH":
+                return self._parse_refresh()
             if u == "EXPLAIN":
                 self.i += 1
                 analyze = bool(self.eat_kw("ANALYZE"))
@@ -178,7 +183,13 @@ class Parser:
         if self.eat_kw("OR"):
             self.expect_kw("REPLACE")
             or_replace = True
-        kind = self.expect_kw("TABLE", "VIEW", "MODEL", "SCHEMA", "EXPERIMENT")
+        materialized = bool(self.eat_kw("MATERIALIZED"))
+        if materialized:
+            self.expect_kw("VIEW")
+            kind = "MATERIALIZED VIEW"
+        else:
+            kind = self.expect_kw("TABLE", "VIEW", "MODEL", "SCHEMA",
+                                  "EXPERIMENT")
         if_not_exists = False
         if self.eat_kw("IF"):
             self.expect_kw("NOT")
@@ -191,6 +202,13 @@ class Parser:
                                 or_replace=or_replace, pos=pos)
 
         name = self.compound_identifier()
+
+        if kind == "MATERIALIZED VIEW":
+            self.expect_kw("AS")
+            query = self._parse_parenthesized_or_plain_query()
+            return CreateMaterializedView(
+                name=name, query=query, if_not_exists=if_not_exists,
+                or_replace=or_replace, pos=pos)
 
         if kind in ("MODEL", "EXPERIMENT"):
             kwargs = {}
@@ -289,7 +307,12 @@ class Parser:
     def _parse_drop(self) -> Statement:
         pos = (self.cur.line, self.cur.col)
         self.expect_kw("DROP")
-        kind = self.expect_kw("TABLE", "MODEL", "SCHEMA", "VIEW")
+        materialized = bool(self.eat_kw("MATERIALIZED"))
+        if materialized:
+            self.expect_kw("VIEW")
+            kind = "MATERIALIZED VIEW"
+        else:
+            kind = self.expect_kw("TABLE", "MODEL", "SCHEMA", "VIEW")
         if_exists = False
         if self.eat_kw("IF"):
             self.expect_kw("EXISTS")
@@ -299,7 +322,36 @@ class Parser:
         name = self.compound_identifier()
         if kind == "MODEL":
             return DropModel(name=name, if_exists=if_exists, pos=pos)
+        if kind == "MATERIALIZED VIEW":
+            return DropMaterializedView(name=name, if_exists=if_exists,
+                                        pos=pos)
         return DropTable(name=name, if_exists=if_exists, pos=pos)
+
+    def _parse_insert(self) -> Statement:
+        pos = (self.cur.line, self.cur.col)
+        self.expect_kw("INSERT")
+        self.expect_kw("INTO")
+        table = self.compound_identifier()
+        columns = None
+        # '(' here is ambiguous: a column list or a parenthesized query —
+        # a following SELECT/VALUES/WITH token decides
+        if self.at_op("(") and not self.at_kw("SELECT", "VALUES", "WITH",
+                                              k=1):
+            self.expect_op("(")
+            columns = [self.identifier("column name")]
+            while self.eat_op(","):
+                columns.append(self.identifier("column name"))
+            self.expect_op(")")
+        query = self.parse_query()
+        return InsertInto(table=table, columns=columns, query=query, pos=pos)
+
+    def _parse_refresh(self) -> Statement:
+        pos = (self.cur.line, self.cur.col)
+        self.expect_kw("REFRESH")
+        self.expect_kw("MATERIALIZED")
+        self.expect_kw("VIEW")
+        return RefreshMaterializedView(name=self.compound_identifier(),
+                                       pos=pos)
 
     def _parse_show(self) -> Statement:
         pos = (self.cur.line, self.cur.col)
@@ -1166,6 +1218,15 @@ import re as _re
 _EXPLAIN_ANALYZE_RE = _re.compile(r"^\s*EXPLAIN\s+(ANALYZE|PROFILE)\b",
                                   _re.IGNORECASE)
 
+# Same story for the materialized-view / append grammar (ISSUE 14): the
+# native C++ grammar predates CREATE/DROP MATERIALIZED VIEW, REFRESH
+# MATERIALIZED VIEW and INSERT INTO, so these statements route directly to
+# the Python parser instead of bouncing off a native parse error.
+_MATVIEW_STMT_RE = _re.compile(
+    r"^\s*(INSERT|REFRESH)\b"
+    r"|^\s*(CREATE|DROP)\s+(OR\s+REPLACE\s+)?MATERIALIZED\b",
+    _re.IGNORECASE)
+
 
 def parse_sql(sql: str) -> List[Statement]:
     """Parse SQL text into AST statements.
@@ -1179,7 +1240,7 @@ def parse_sql(sql: str) -> List[Statement]:
     from .. import native as _native
     from . import native_bridge
 
-    if _EXPLAIN_ANALYZE_RE.match(sql):
+    if _EXPLAIN_ANALYZE_RE.match(sql) or _MATVIEW_STMT_RE.match(sql):
         return Parser(sql).parse_statements()
     envelope = _native.parse_to_json(sql)
     if envelope is not None:

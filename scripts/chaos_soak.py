@@ -12,6 +12,10 @@ the ROADMAP's serving story needs (run by scripts/ci_local.sh as
     queries from a fixed menu (agg / join+agg / filter+topk / global agg /
     chunked streaming) at random priorities through the armed workload
     manager (2 slots) for ``--budget-s`` seconds;
+  * one MV-churn client appends random batches into its own base table
+    and reads a maintained materialized view against a self-maintained
+    pandas oracle — the ``mv_refresh`` site makes incremental refreshes
+    fall back to full recomputes mid-soak (wrong-never, slower-ok);
   * EVERY injection site (runtime/faults.py SITES) is armed
     probabilistically at ``--p`` (default 0.05) with per-site seeds, plus
     a rarer FATAL compile fault that exercises the exile + quarantine
@@ -148,15 +152,8 @@ def main(argv=None) -> int:
     os.environ["DSQL_QUARANTINE_FILE"] = os.path.join(qdir, "quarantine.json")
     os.environ["DSQL_QUARANTINE_TTL_S"] = "5"      # let probes happen in-soak
 
-    # probabilistic faults on EVERY site, deterministic per-site streams,
-    # plus a rare FATAL compile fault (exile + quarantine coverage)
-    from dask_sql_tpu.runtime import faults
-    spec = ",".join(f"{s}:p={args.p}:seed={args.seed + i}"
-                    for i, s in enumerate(faults.SITES))
-    spec += f",compile:p={args.p / 5:.4f}:seed={args.seed + 100}:fatal"
-    os.environ["DSQL_FAULT_INJECT"] = spec
-
     from dask_sql_tpu import Context
+    from dask_sql_tpu.runtime import faults
     from dask_sql_tpu.runtime import resilience as res
     from dask_sql_tpu.runtime import scheduler as sched
     from dask_sql_tpu.runtime import telemetry as tel
@@ -170,6 +167,20 @@ def main(argv=None) -> int:
     ctx.create_table("tc", t1, chunked=True, batch_rows=512)
     ctx.create_table("tc2", t2, chunked=True, batch_rows=512)
     menu = _menu(t1, t2)
+
+    # the MV-churn client's private base + maintained view (built before
+    # faults arm: the soak measures the loop, not the setup)
+    tm = t1[["k", "v"]].copy()
+    ctx.create_table("tm", tm)
+    ctx.sql("CREATE MATERIALIZED VIEW vm AS "
+            "SELECT k, SUM(v) AS s, COUNT(*) AS n FROM tm GROUP BY k")
+
+    # probabilistic faults on EVERY site, deterministic per-site streams,
+    # plus a rare FATAL compile fault (exile + quarantine coverage)
+    spec = ",".join(f"{s}:p={args.p}:seed={args.seed + i}"
+                    for i, s in enumerate(faults.SITES))
+    spec += f",compile:p={args.p / 5:.4f}:seed={args.seed + 100}:fatal"
+    os.environ["DSQL_FAULT_INJECT"] = spec
 
     c0 = tel.REGISTRY.counters()
     lock = threading.Lock()
@@ -211,8 +222,57 @@ def main(argv=None) -> int:
             with lock:
                 stats["ok"] += 1
 
+    def mv_client() -> None:
+        # single mutator of tm: the pandas oracle below is authoritative.
+        # Appends go through Context.append_rows directly (deterministic —
+        # the mutation either lands with its delta record or raises before
+        # touching the catalog), reads go through the full ctx.sql path
+        # where admission faults, refresh faults, and the scheduler apply.
+        rng = random.Random(args.seed * 1000 + 7777)
+        oracle = tm.copy()
+        while time.monotonic() < t_end:
+            if rng.random() < 0.4:
+                add = pd.DataFrame({
+                    "k": [rng.randrange(20) for _ in range(8)],
+                    "v": [round(rng.random() * 10, 3) for _ in range(8)],
+                })
+                ctx.append_rows("tm", add)
+                oracle = pd.concat([oracle, add], ignore_index=True)
+                continue
+            expected = oracle.groupby("k", as_index=False).agg(
+                s=("v", "sum"), n=("v", "size"))
+            pr = PRIORITIES[rng.randrange(len(PRIORITIES))]
+            with lock:
+                stats["submitted"] += 1
+            try:
+                got = ctx.sql("SELECT * FROM vm", return_futures=False,
+                              timeout=QUERY_TIMEOUT_S, priority=pr)
+            except res.ResilienceError:
+                with lock:
+                    stats["typed"] += 1
+                continue
+            except Exception as e:  # noqa: BLE001 - the gate records it
+                with lock:
+                    stats["untyped"] += 1
+                    problems.append(f"untyped {type(e).__name__} on the "
+                                    f"matview read: {e}")
+                continue
+            try:
+                pd.testing.assert_frame_equal(
+                    _norm(got), _norm(expected), check_dtype=False,
+                    rtol=1e-6, atol=1e-9)
+            except AssertionError as e:
+                with lock:
+                    stats["wrong"] += 1
+                    problems.append("WRONG RESULT on the matview read "
+                                    f"(stale or corrupt): {str(e)[:300]}")
+                continue
+            with lock:
+                stats["ok"] += 1
+
     threads = [threading.Thread(target=client, args=(i,), daemon=True)
                for i in range(args.clients)]
+    threads.append(threading.Thread(target=mv_client, daemon=True))
     for th in threads:
         th.start()
     hung = 0
@@ -285,7 +345,9 @@ def main(argv=None) -> int:
                    "stage_replay_saved_stages", "stage_execs",
                    "quarantine_skips", "quarantine_probes",
                    "quarantine_marks", "exiled", "deadline_exceeded",
-                   "result_cache_hits")
+                   "result_cache_hits", "mv_serves",
+                   "mv_refresh_incremental", "mv_refresh_full",
+                   "mv_deltas_recorded")
     fault_counts = {k: d(k) for k in c1 if k.startswith("fault_") and d(k)}
     print(f"chaos soak: {stats['submitted']} submitted over "
           f"{args.budget_s:.0f} s x {args.clients} clients (p={args.p}) -> "

@@ -99,6 +99,13 @@ echo "=== [2l] perf sentinel (bench regression gate) ==="
 python scripts/perf_sentinel.py
 python scripts/perf_sentinel.py --self-test
 
+echo "=== [2m] matview smoke (incremental view maintenance) ==="
+# a 1k-row append into a 1M-row base must refresh the maintained view
+# >=5x faster than recomputing the defining query, stay pandas-oracle
+# exact across appends and an overwrite, reconcile the mv_* counters,
+# and DSQL_MV=0 must restore pre-subsystem behavior
+python scripts/mv_smoke.py
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \

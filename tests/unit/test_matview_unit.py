@@ -1,0 +1,290 @@
+"""Unit tests for the materialized-view subsystem (runtime/matview.py):
+parser/AST forms, maintainability analysis, the registry's delta/tombstone
+seam, and append_rows coercion — no full-query oracle runs (those live in
+tests/integration/test_matview.py)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu.runtime import matview as mv
+from dask_sql_tpu.runtime.resilience import UserError
+from dask_sql_tpu.sql import ast as A
+from dask_sql_tpu.sql.parser import parse_sql
+
+
+@pytest.fixture(autouse=True)
+def _cache_on(monkeypatch):
+    monkeypatch.setenv("DSQL_RESULT_CACHE_MB", "64")
+    yield
+
+
+# ---------------------------------------------------------------------------
+# parser / AST
+# ---------------------------------------------------------------------------
+
+def test_parse_create_matview():
+    (stmt,) = parse_sql(
+        "CREATE MATERIALIZED VIEW v AS SELECT a, SUM(b) FROM t GROUP BY a")
+    assert isinstance(stmt, A.CreateMaterializedView)
+    assert stmt.name == ["v"]
+    assert not stmt.or_replace and not stmt.if_not_exists
+
+
+def test_parse_create_matview_or_replace_if_not_exists():
+    (s1,) = parse_sql("CREATE OR REPLACE MATERIALIZED VIEW s.v AS "
+                      "(SELECT 1 AS x)")
+    assert isinstance(s1, A.CreateMaterializedView)
+    assert s1.or_replace and s1.name == ["s", "v"]
+    (s2,) = parse_sql("CREATE MATERIALIZED VIEW IF NOT EXISTS v AS "
+                      "SELECT 1 AS x")
+    assert s2.if_not_exists
+
+
+def test_parse_drop_refresh_matview():
+    (d,) = parse_sql("DROP MATERIALIZED VIEW IF EXISTS v")
+    assert isinstance(d, A.DropMaterializedView) and d.if_exists
+    (r,) = parse_sql("REFRESH MATERIALIZED VIEW s.v")
+    assert isinstance(r, A.RefreshMaterializedView)
+    assert r.name == ["s", "v"]
+
+
+def test_parse_insert_forms():
+    (i1,) = parse_sql("INSERT INTO t VALUES (1, 'a'), (2, NULL)")
+    assert isinstance(i1, A.InsertInto)
+    assert i1.columns is None
+    (i2,) = parse_sql("INSERT INTO t (a, b) VALUES (1, 2)")
+    assert i2.columns == ["a", "b"]
+    (i3,) = parse_sql("INSERT INTO t SELECT * FROM s")
+    assert i3.columns is None
+    # '(' after the table name may open a parenthesized query, not a
+    # column list
+    (i4,) = parse_sql("INSERT INTO t (SELECT * FROM s)")
+    assert i4.columns is None
+
+
+def test_plain_create_view_still_parses():
+    (stmt,) = parse_sql("CREATE VIEW v AS SELECT 1 AS x")
+    assert isinstance(stmt, A.CreateTableAs) and stmt.view
+
+
+# ---------------------------------------------------------------------------
+# maintainability analysis
+# ---------------------------------------------------------------------------
+
+def _ctx():
+    c = Context()
+    c.create_table("t", pd.DataFrame({
+        "k": ["a", "b", "a"], "x": [1.0, 2.0, 3.0], "y": [1, 2, 3]}))
+    return c
+
+
+def _shape_of(c, sql):
+    plan = c._get_plan(parse_sql(sql)[0].query, sql)
+    return mv._analyze(plan, c)
+
+
+@pytest.mark.parametrize("query,kind", [
+    ("SELECT k, SUM(x) AS s FROM t GROUP BY k", "agg"),
+    ("SELECT k, AVG(y) AS a, COUNT(*) AS n FROM t GROUP BY k", "agg"),
+    ("SELECT MIN(x) AS mn, MAX(x) AS mx FROM t", "agg"),
+    ("SELECT k, x FROM t WHERE y > 1", "append"),
+    ("SELECT UPPER(k) AS ku FROM t", "append"),
+])
+def test_analyze_maintainable(query, kind):
+    c = _ctx()
+    shape, reason = _shape_of(c, query)
+    assert shape is not None, reason
+    assert shape.kind == kind
+
+
+@pytest.mark.parametrize("query,needle", [
+    ("SELECT COUNT(DISTINCT k) AS n FROM t", "DISTINCT"),
+    ("SELECT a.k FROM t a, t b WHERE a.k = b.k", "recompute"),
+    ("SELECT k, x FROM t ORDER BY x LIMIT 2", "ORDER BY"),
+    ("SELECT k FROM (SELECT k, SUM(x) AS s FROM t GROUP BY k) "
+     "GROUP BY k", "nested aggregates"),
+])
+def test_analyze_full_recompute_with_reason(query, needle):
+    c = _ctx()
+    shape, reason = _shape_of(c, query)
+    assert shape is None
+    assert needle.lower() in reason.lower()
+
+
+def test_analyze_having_above_agg_is_maintainable():
+    c = _ctx()
+    shape, reason = _shape_of(
+        c, "SELECT k, SUM(x) AS s FROM t GROUP BY k HAVING SUM(x) > 1")
+    assert shape is not None, reason
+    assert shape.kind == "agg" and shape.above
+
+
+def test_analyze_order_by_above_agg_is_maintainable():
+    # sorting the (small) aggregate output re-runs per refresh: fine
+    c = _ctx()
+    shape, reason = _shape_of(
+        c, "SELECT k, SUM(x) AS s FROM t GROUP BY k ORDER BY k")
+    assert shape is not None, reason
+
+
+# ---------------------------------------------------------------------------
+# registry delta/tombstone seam
+# ---------------------------------------------------------------------------
+
+def test_delta_recorded_only_with_dependent_views():
+    c = _ctx()
+    # no registry at all until the first CREATE MATERIALIZED VIEW
+    assert c.__dict__.get("_matview_registry") is None
+    c.sql("CREATE MATERIALIZED VIEW v AS SELECT k, SUM(x) AS s FROM t "
+          "GROUP BY k")
+    reg = c._matview_registry
+    key = ("root", "t")
+    c.append_rows("t", [("z", 9.0, 9)])
+    assert len(reg.deltas[key]) == 1
+    # a table with no dependent view records nothing
+    c.create_table("u", pd.DataFrame({"a": [1]}))
+    c.append_rows("u", [(2,)])
+    assert ("root", "u") not in reg.deltas
+
+
+def test_overwrite_tombstones_and_clears_deltas():
+    c = _ctx()
+    c.sql("CREATE MATERIALIZED VIEW v AS SELECT k, SUM(x) AS s FROM t "
+          "GROUP BY k")
+    reg = c._matview_registry
+    key = ("root", "t")
+    c.append_rows("t", [("z", 9.0, 9)])
+    assert reg.deltas.get(key)
+    c.create_table("t", pd.DataFrame({
+        "k": ["q"], "x": [0.0], "y": [0]}))
+    assert key not in reg.deltas
+    assert reg.tombstones[key] == c.table_epoch("root", "t")
+
+
+def test_delta_log_overflow_degrades_to_tombstone(monkeypatch):
+    monkeypatch.setattr(mv, "MAX_DELTAS", 3)
+    c = _ctx()
+    c.sql("CREATE MATERIALIZED VIEW v AS SELECT k, SUM(x) AS s FROM t "
+          "GROUP BY k")
+    reg = c._matview_registry
+    key = ("root", "t")
+    for i in range(5):
+        c.append_rows("t", [("z", float(i), i)])
+    # appends 1-3 filled the log, append 4 overflowed it into a tombstone,
+    # append 5 starts a fresh log — the tombstone still forces the next
+    # refresh through a full recompute
+    assert reg.tombstones[key] > 0
+    assert len(reg.deltas.get(key, ())) == 1
+    # the view still refreshes correctly (full recompute)
+    out = c.sql("SELECT SUM(s) AS tot FROM v", return_futures=False)
+    base = c.sql("SELECT SUM(x) AS tot FROM t", return_futures=False)
+    assert float(out["tot"][0]) == float(base["tot"][0])
+
+
+def test_kill_switch_rejects_statements_and_degrades_deltas(monkeypatch):
+    c = _ctx()
+    c.sql("CREATE MATERIALIZED VIEW v AS SELECT k, SUM(x) AS s FROM t "
+          "GROUP BY k")
+    reg = c._matview_registry
+    monkeypatch.setenv("DSQL_MV", "0")
+    with pytest.raises(UserError):
+        c.sql("CREATE MATERIALIZED VIEW w AS SELECT k FROM t")
+    with pytest.raises(UserError):
+        c.sql("REFRESH MATERIALIZED VIEW v")
+    with pytest.raises(UserError):
+        c.sql("DROP MATERIALIZED VIEW v")
+    # appends degrade to tombstones while disabled
+    c.append_rows("t", [("z", 9.0, 9)])
+    assert ("root", "t") not in reg.deltas
+    assert reg.tombstones[("root", "t")] > 0
+
+
+def test_volatile_query_rejected_with_typed_error():
+    c = _ctx()
+    with pytest.raises(mv.MatViewError) as ei:
+        c.sql("CREATE MATERIALIZED VIEW v AS SELECT k, CURRENT_DATE AS d "
+              "FROM t")
+    assert "volatile" in str(ei.value)
+    with pytest.raises(mv.MatViewError):
+        c.sql("CREATE MATERIALIZED VIEW v AS SELECT CURRENT_TIME AS ts")
+    with pytest.raises(mv.MatViewError):
+        c.sql("CREATE MATERIALIZED VIEW v AS SELECT RAND() AS r")
+    # nothing half-registered
+    assert c.resolve_table(["v"]) is None
+
+
+def test_duplicate_name_checks():
+    c = _ctx()
+    c.sql("CREATE MATERIALIZED VIEW v AS SELECT k FROM t")
+    with pytest.raises(UserError):
+        c.sql("CREATE MATERIALIZED VIEW v AS SELECT x FROM t")
+    c.sql("CREATE MATERIALIZED VIEW IF NOT EXISTS v AS SELECT x FROM t")
+    c.sql("CREATE OR REPLACE MATERIALIZED VIEW v AS SELECT x FROM t")
+    got = c.sql("SELECT * FROM v", return_futures=False)
+    assert list(got.columns) == ["x"]
+    with pytest.raises(UserError):
+        c.sql("DROP MATERIALIZED VIEW nope")
+    c.sql("DROP MATERIALIZED VIEW IF EXISTS nope")
+
+
+# ---------------------------------------------------------------------------
+# append_rows coercion
+# ---------------------------------------------------------------------------
+
+def test_append_rows_coercion_paths():
+    c = _ctx()
+    n0 = c.schema["root"].tables["t"].table.num_rows
+    # dict of columns, case-insensitive names, any order
+    c.append_rows("t", {"Y": [7], "K": ["d"], "X": [4.0]})
+    # pandas frame
+    c.append_rows("t", pd.DataFrame({"k": ["e"], "x": [5.0], "y": [8]}))
+    # list of tuples, positional
+    assert c.append_rows("t", [("f", 6.0, 9), ("g", 7.0, 10)]) == 2
+    t = c.schema["root"].tables["t"].table
+    assert t.num_rows == n0 + 4
+    # types still match the original columns
+    orig = _ctx().schema["root"].tables["t"].table
+    assert [col.stype.name for col in t.columns] == \
+        [col.stype.name for col in orig.columns]
+
+
+def test_append_rows_int_literal_casts_to_double():
+    c = _ctx()
+    c.append_rows("t", [("h", 8, 11)])  # x is DOUBLE, 8 is int
+    t = c.schema["root"].tables["t"].table
+    assert t.column("x").stype.name == "DOUBLE"
+
+
+def test_append_rows_errors_are_typed():
+    c = _ctx()
+    with pytest.raises(UserError):
+        c.append_rows("missing", [(1,)])
+    with pytest.raises(UserError):
+        c.append_rows("t", {"k": ["a"]})  # missing columns
+    c.sql("CREATE VIEW lazyv AS SELECT k FROM t")
+    with pytest.raises(UserError):
+        c.append_rows("lazyv", [("a",)])
+    c.sql("CREATE MATERIALIZED VIEW matv AS SELECT k FROM t")
+    with pytest.raises(UserError) as ei:
+        c.append_rows("matv", [("a",)])
+    assert "materialized view" in str(ei.value)
+
+
+def test_append_rows_chunked_rejected():
+    c = Context()
+    c.create_table("big", pd.DataFrame({"a": np.arange(100)}),
+                   chunked=True, batch_rows=32)
+    with pytest.raises(UserError):
+        c.append_rows("big", [(1,)])
+
+
+def test_insert_into_column_list_fills_null():
+    c = _ctx()
+    c.sql("INSERT INTO t (x, k) VALUES (9.5, 'q')")
+    got = c.sql("SELECT y FROM t WHERE k = 'q'", return_futures=False)
+    assert got["y"].isna().all()
+    with pytest.raises(UserError):
+        c.sql("INSERT INTO t (nope) VALUES (1)")
+    with pytest.raises(UserError):
+        c.sql("INSERT INTO t (k, x) VALUES (1)")  # arity mismatch
