@@ -1228,4 +1228,13 @@ def try_execute_spmd(plan: RelNode, context) -> Optional[Table]:
     if skew is not None:
         ann["skew_ratio"] = skew
     _tel.annotate(**ann)
+    if os.environ.get("DSQL_EVENTS", "0").strip() not in ("", "0"):
+        try:
+            from ..runtime import events as _ev
+            _ev.publish("spmd.query", devices=n_dev,
+                        stages=len(graph.stages),
+                        exchange_bytes=bytes_moved,
+                        skew_ratio=skew)
+        except Exception:  # pragma: no cover - bus is advisory
+            pass
     return result

@@ -2027,6 +2027,12 @@ def _profile_on() -> bool:
     return os.environ.get("DSQL_PROFILE", "0").strip() not in ("", "0")
 
 
+def _events_on() -> bool:
+    """Watchtower event bus armed?  Same discipline as _profile_on —
+    env checked BEFORE importing runtime.events."""
+    return os.environ.get("DSQL_EVENTS", "0").strip() not in ("", "0")
+
+
 def _pstore_put(entry: _Compiled, base_key, n_args: int, n_outs: int
                 ) -> None:
     """Serialize + persist a freshly compiled program (best-effort; only
@@ -2637,6 +2643,11 @@ def _record_stage_stats(st, idx: int, out: Table, query_fp: str,
                              capacity=capacity, nbytes=nbytes,
                              wall_ms=wall_ms, device_ms=device_ms or None,
                              query_fp=query_fp)
+        if _events_on():
+            from ..runtime import events as _ev
+            _ev.publish("stage.done", digest=digest, index=idx,
+                        rows_out=rows_out, bytes=nbytes,
+                        wall_ms=round(wall_ms, 3))
     except Exception:  # recording must never fail a stage
         _tel.inc("history_errors")
         logger.debug("stage stat capture failed", exc_info=True)
@@ -2948,13 +2959,16 @@ def _programs_ready(plan: RelNode, context, base_key, budget: int) -> bool:
     return True
 
 
-def _background_compile(plan: RelNode, context, base_key) -> None:
+def _background_compile(plan: RelNode, context, base_key,
+                        trace_id: Optional[str] = None) -> None:
     """Compile (and once-execute) this plan's stage programs off the query
     path.  Runs in a daemon thread with fresh thread-locals: no deadline,
     no trace, no scheduler slot, no memory-broker reservation — exactly
     the full normal pipeline minus supervision, so learned caps, the
     program cache, quarantine interplay, and the persistent store all
-    populate the same way a foreground compile would."""
+    populate the same way a foreground compile would.  ``trace_id`` is the
+    scheduling query's watchtower ID, captured at spawn time because a
+    daemon thread's fresh thread-locals can't see the caller's trace."""
     _tier_local.bg = True
     trace = None
     try:
@@ -2966,15 +2980,26 @@ def _background_compile(plan: RelNode, context, base_key) -> None:
             # exports it without counting a query or arming the slow log.
             trace = _tel.QueryTrace(f"<background-compile:{base_key[0][:48]}>")
             trace.root.name = "background_compile"
+            if trace_id:
+                trace.root.attrs["trace_id"] = trace_id
             try:
                 with _tel.scoped(trace, trace.root):
                     try_execute_compiled(plan, context)
                 _tel.inc("background_compiles_done")
+                if _events_on():
+                    from ..runtime import events as _ev
+                    _ev.publish("compile.background.done", trace=trace_id,
+                                plan=base_key[0][:48])
             except (KeyboardInterrupt, SystemExit):
                 raise
             except BaseException as e:
                 trace.root.attrs["error"] = type(e).__name__
                 _tel.inc("background_compile_errors")
+                if _events_on():
+                    from ..runtime import events as _ev
+                    _ev.publish("compile.background.error", trace=trace_id,
+                                plan=base_key[0][:48],
+                                error=type(e).__name__)
                 logger.warning("background compile failed (%s: %s)",
                                type(e).__name__, str(e)[:200])
     finally:
@@ -3012,8 +3037,15 @@ def _tier_serve_eager(plan: RelNode, context, base_key, budget: int,
             _bg_sem = _threading.Semaphore(_compile_workers())
     # daemon threads (not a pool): process exit must never block on a
     # wedged XLA build, and the semaphore bounds real concurrency
+    tid = None
+    if _events_on():
+        try:
+            from ..runtime import events as _ev
+            tid = _ev.current_trace_id()
+        except Exception:
+            tid = None
     _threading.Thread(target=_background_compile,
-                      args=(plan, context, base_key),
+                      args=(plan, context, base_key, tid),
                       name="dsql-bg-compile", daemon=True).start()
     return True
 

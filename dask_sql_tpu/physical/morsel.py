@@ -452,6 +452,12 @@ def grace_join_split(join: LogicalJoin, context):
 
     with _tel.span("grace_join", partitions=P, spilled=True):
         _tel.inc("morsel_joins")
+        if os.environ.get("DSQL_EVENTS", "0").strip() not in ("", "0"):
+            try:
+                from ..runtime import events as _ev
+                _ev.publish("morsel.join", partitions=P)
+            except Exception:  # pragma: no cover - bus is advisory
+                pass
         llayout = _partition_side(join.left, lscan, lsrc, context, lkeys,
                                   P, runs_l, store)
         rlayout = _partition_side(join.right, rscan, rsrc, context, rkeys,

@@ -95,6 +95,31 @@ def stats_path() -> Optional[str]:
     return f"{path}.stats" if path else None
 
 
+def stats_ttl_s() -> float:
+    """``DSQL_HISTORY_STATS_TTL_S``: fingerprints whose EWMA entry was
+    not refreshed within this window are pruned at ring truncation
+    (default 7 days — long enough to survive a weekend of idleness,
+    short enough that one-off ad-hoc plans don't accrete forever)."""
+    raw = os.environ.get("DSQL_HISTORY_STATS_TTL_S", "")
+    try:
+        ttl = float(raw) if raw else 7 * 86400.0
+    except ValueError:
+        ttl = 7 * 86400.0
+    return max(ttl, 0.0)
+
+
+def stats_max_entries() -> int:
+    """``DSQL_HISTORY_STATS_MAX``: hard entry cap on the sidecar (newest
+    ``updated`` wins) — the TTL alone cannot bound a fast churn of
+    *recent* fingerprints."""
+    raw = os.environ.get("DSQL_HISTORY_STATS_MAX", "")
+    try:
+        n = int(raw) if raw else 4096
+    except ValueError:
+        n = 4096
+    return max(n, 16)
+
+
 _STATS = MtimeCachedJsonFile(stats_path)
 
 
@@ -147,6 +172,38 @@ def _truncate_ring(path: str) -> None:
         except OSError:
             logger.debug("history ring truncation failed", exc_info=True)
             _tel.inc("history_errors")
+    # sidecar maintenance rides the ring's own cadence: the sidecar only
+    # grows while envelopes are appended, and appends are what trigger
+    # truncation — so pruning here bounds the .stats file under churn
+    # without a timer thread
+    _prune_stats()
+
+
+def _prune_stats() -> None:
+    """Bound the EWMA sidecar: drop fingerprints not observed within
+    ``stats_ttl_s()``, then cap survivors to ``stats_max_entries()``
+    newest-by-``updated``.  Read-filter-replace under kvstore discipline:
+    a racing ``_observe_stat`` can resurrect one entry, never corrupt."""
+    try:
+        data = _STATS.read()
+        if not data:
+            return
+        now = time.time()
+        ttl = stats_ttl_s()
+        keep = {fp: e for fp, e in data.items()
+                if isinstance(e, dict)
+                and now - float(e.get("updated", 0) or 0) <= ttl}
+        cap = stats_max_entries()
+        if len(keep) > cap:
+            newest = sorted(keep.items(),
+                            key=lambda kv: float(kv[1].get("updated", 0)
+                                                 or 0),
+                            reverse=True)[:cap]
+            keep = dict(newest)
+        if len(keep) != len(data):
+            _STATS.write(keep)
+    except Exception:
+        logger.debug("stats sidecar prune failed", exc_info=True)
 
 
 def read_events(kind: Optional[str] = None,
@@ -310,6 +367,11 @@ def record_query(report, error: Optional[BaseException] = None) -> None:
                      if getattr(report, "cost_err", None) is not None
                      else -1.0),
     }
+    # end-to-end trace ID (runtime/events.py, DSQL_EVENTS=1): present
+    # only when one was minted, so unarmed envelopes stay byte-identical
+    tid = getattr(report, "trace_id", None)
+    if tid:
+        rec["trace"] = str(tid)
     _append(path, rec)
     if plan_fp and error is None and measured > 0:
         _observe_stat(plan_fp, nbytes=measured, rows=report.rows_out,

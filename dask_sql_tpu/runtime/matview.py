@@ -396,6 +396,14 @@ class MatViewRegistry:
                 _faults.maybe_fail("mv_refresh")
                 self._refresh_incremental(context, mv, info)
                 _tel.inc("mv_refresh_incremental")
+                if os.environ.get("DSQL_EVENTS", "0").strip() \
+                        not in ("", "0"):
+                    try:
+                        from . import events as _ev
+                        _ev.publish("mv.refresh", view=mv.name,
+                                    mode="incremental")
+                    except Exception:  # pragma: no cover
+                        pass
                 mv.refresh_incremental += 1
                 mv.last_refresh_reason = "incremental"
                 self._prune_locked()
@@ -530,6 +538,13 @@ class MatViewRegistry:
             for key in mv.base_tables:
                 self.tombstones.pop(key, None)
             _tel.inc("mv_refresh_full")
+            if os.environ.get("DSQL_EVENTS", "0").strip() not in ("", "0"):
+                try:
+                    from . import events as _ev
+                    _ev.publish("mv.refresh", view=mv.name, mode="full",
+                                reason=reason or None)
+                except Exception:  # pragma: no cover
+                    pass
             mv.refresh_full += 1
             mv.last_refresh_reason = f"full ({reason})" if reason else "full"
             if reason:

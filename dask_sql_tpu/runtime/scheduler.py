@@ -91,6 +91,13 @@ from .resilience import (AdmissionRejected, AdmissionTimeout, ServerDraining,
 
 logger = logging.getLogger(__name__)
 
+
+def _events_on() -> bool:
+    # watchtower gate: env checked BEFORE importing events.py, so the
+    # bus stays un-imported (zero cost) when disarmed
+    return os.environ.get("DSQL_EVENTS", "0").strip() not in ("", "0")
+
+
 PRIORITIES = ("interactive", "batch", "background")
 
 # DWRR weights: long-run slot share under sustained mixed load.  interactive
@@ -779,10 +786,23 @@ class WorkloadManager:
                 logger.debug("working-set estimate failed", exc_info=True)
                 est, est_src = _MIN_ESTIMATE, "floor"
         with _tel.span("queued", priority=pr):
-            ticket = self.acquire(pr, est, seat=seat)
+            try:
+                ticket = self.acquire(pr, est, seat=seat)
+            except Exception as e:
+                if _events_on():
+                    from . import events as _ev
+                    _ev.publish("sched.rejected", priority=pr,
+                                est_bytes=int(est),
+                                error=type(e).__name__)
+                raise
             _tel.annotate(queued_ms=round(ticket.queued_ms or 0.0, 3),
                           reserved_bytes=ticket.reserved_bytes,
                           est_bytes=int(est), est_source=est_src)
+        if _events_on():
+            from . import events as _ev
+            _ev.publish("sched.admitted", priority=pr,
+                        queued_ms=round(ticket.queued_ms or 0.0, 3),
+                        est_bytes=int(est), est_source=est_src)
         rt = _res.current()
         backoff0 = rt.backoff_s if rt is not None else 0.0
         _tls.ticket = ticket

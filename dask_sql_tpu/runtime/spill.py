@@ -356,6 +356,12 @@ class SpillStore:
         if chunks is None:
             chunks = self._runs[run] = []
             _tel.inc("spill_partitions")
+            if os.environ.get("DSQL_EVENTS", "0").strip() not in ("", "0"):
+                try:
+                    from . import events as _ev
+                    _ev.publish("spill.run", run=run)
+                except Exception:  # pragma: no cover - bus is advisory
+                    pass
         return chunks
 
     def _chunk_locked(self, run: str, idx: int) -> _Chunk:

@@ -15,6 +15,8 @@ occupy result-cache budget or interact with catalog epochs):
 - ``system.quarantine``  standing compiler-crash verdicts
 - ``system.programs``    persistent program-store index
 - ``system.devices``     per-local-device HBM in-use/peak/limit
+- ``system.events``      watchtower event bus ring (DSQL_EVENTS armed)
+- ``system.slo``         per-class latency objectives + burn rates
 
 Every table has a FIXED column schema with explicit dtypes so an empty
 engine still binds and executes ``SELECT * FROM system.queries`` — object
@@ -30,7 +32,7 @@ from ..table import Table
 
 TABLE_NAMES = ("queries", "active", "metrics", "cache", "quarantine",
                "programs", "table_stats", "mesh", "spill", "devices",
-               "matviews", "view_candidates")
+               "matviews", "view_candidates", "events", "slo")
 
 
 def _col(rows: List[dict], key: str, dtype, default):
@@ -364,6 +366,54 @@ def _view_candidates(context=None) -> Table:
     })
 
 
+def _events() -> Table:
+    """Watchtower bus ring (runtime/events.py): one row per structured
+    event, trace-correlatable with ``system.queries``.  Reads the env gate
+    BEFORE importing events — with ``DSQL_EVENTS`` off this yields the
+    fixed empty schema and the module stays un-imported."""
+    import os
+
+    rows: List[dict] = []
+    if os.environ.get("DSQL_EVENTS", "0").strip() not in ("", "0"):
+        from . import events as _ev
+
+        rows = _ev.events_rows()
+    return Table.from_pydict({
+        "seq": _col(rows, "seq", np.int64, 0),
+        "unix": _col(rows, "unix", np.float64, 0.0),
+        "pid": _col(rows, "pid", np.int64, 0),
+        "trace": _col(rows, "trace", object, ""),
+        "type": _col(rows, "type", object, ""),
+        "detail": _col(rows, "detail", object, ""),
+    })
+
+
+def _slo() -> Table:
+    """Per-priority-class latency objectives and their multi-window burn
+    rates (runtime/events.py SloMonitor).  Same zero-import discipline as
+    ``system.events`` — empty fixed schema when the watchtower is off."""
+    import os
+
+    rows: List[dict] = []
+    if os.environ.get("DSQL_EVENTS", "0").strip() not in ("", "0"):
+        from . import events as _ev
+
+        rows = _ev.slo_rows()
+    return Table.from_pydict({
+        "class": _col(rows, "class", object, ""),
+        "objective_ms": _col(rows, "objective_ms", np.float64, 0.0),
+        "target": _col(rows, "target", np.float64, 0.0),
+        "window_fast_s": _col(rows, "window_fast_s", np.float64, 0.0),
+        "window_slow_s": _col(rows, "window_slow_s", np.float64, 0.0),
+        "total": _col(rows, "total", np.int64, 0),
+        "breaches": _col(rows, "breaches", np.int64, 0),
+        "attainment": _col(rows, "attainment", np.float64, 1.0),
+        "burn_fast": _col(rows, "burn_fast", np.float64, 0.0),
+        "burn_slow": _col(rows, "burn_slow", np.float64, 0.0),
+        "breach": _col(rows, "breach", np.bool_, False),
+    })
+
+
 _BUILDERS: Dict[str, object] = {
     "queries": _queries,
     "active": _active,
@@ -377,6 +427,8 @@ _BUILDERS: Dict[str, object] = {
     "devices": _devices,
     "matviews": _matviews,
     "view_candidates": _view_candidates,
+    "events": _events,
+    "slo": _slo,
 }
 
 #: builders that need the resolving context (catalog / mesh live there)

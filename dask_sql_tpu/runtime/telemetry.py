@@ -152,6 +152,11 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     # batches logged on the delta seam, and the refresh chaos site
     "mv_serves", "mv_refresh_incremental", "mv_refresh_full",
     "mv_deltas_recorded", "fault_mv_refresh",
+    # watchtower event bus + SLO monitor (runtime/events.py,
+    # DSQL_EVENTS=1): events published to the bounded bus, publishes
+    # that failed and were dropped (never the caller's problem), and
+    # edge-triggered multi-window SLO burn-rate breaches
+    "events_published", "events_dropped", "slo_breaches",
 )
 
 STABLE_HISTOGRAMS: Tuple[str, ...] = (
@@ -175,6 +180,16 @@ STABLE_GAUGES: Tuple[str, ...] = (
     # without memory stats, e.g. CPU)
     "profile_hbm_bytes_in_use", "profile_hbm_peak_bytes",
     "profile_hbm_bytes_limit",
+    # SLO monitor (runtime/events.py, DSQL_EVENTS=1): per-priority-class
+    # lifetime attainment and multi-window burn rates (breach fraction
+    # over the window / error budget; 1.0 = spending the budget exactly
+    # at the sustainable pace)
+    "slo_attainment_interactive", "slo_attainment_batch",
+    "slo_attainment_background",
+    "slo_burn_fast_interactive", "slo_burn_fast_batch",
+    "slo_burn_fast_background",
+    "slo_burn_slow_interactive", "slo_burn_slow_batch",
+    "slo_burn_slow_background",
 )
 
 # exponential-ish bucket bounds in milliseconds; histograms are BOUNDED by
@@ -530,7 +545,7 @@ class QueryReport:
     __slots__ = ("query", "wall_ms", "phases", "counters", "root",
                  "rows_out", "bytes_out", "started_unix", "cache", "tier",
                  "priority", "operators", "spilled", "skew_ratio",
-                 "collective_bytes", "cost_err")
+                 "collective_bytes", "cost_err", "trace_id")
 
     def __init__(self, trace: QueryTrace):
         root = trace.root
@@ -538,6 +553,11 @@ class QueryReport:
         self.started_unix = trace.started_unix
         self.wall_ms = root.wall_ms
         self.root = root
+        # end-to-end trace ID (runtime/events.py stamps it on the root at
+        # trace open when DSQL_EVENTS is armed); None when the
+        # watchtower is off — consumers emit it only when present
+        tid = root.attrs.get("trace_id")
+        self.trace_id = str(tid) if tid else None
         self.rows_out = int(root.attrs.get("rows_out", 0))
         self.bytes_out = int(root.attrs.get("bytes_out", 0))
         phases: Dict[str, float] = {}
@@ -644,6 +664,7 @@ class QueryReport:
 
     def to_dict(self) -> dict:
         return {"query": self.query, "wall_ms": round(self.wall_ms, 3),
+                "trace_id": self.trace_id,
                 "phases": {k: round(v, 3) for k, v in self.phases.items()},
                 "counters": dict(self.counters),
                 "cache": dict(self.cache),
@@ -706,9 +727,12 @@ class QueryReport:
                              else repr(v))
                          for k, v in s.attrs.items()},
             })
+        other = {"query": self.query[:500]}
+        if self.trace_id:
+            other["trace_id"] = self.trace_id
         return {"traceEvents": events,
                 "displayTimeUnit": "ms",
-                "otherData": {"query": self.query[:500]}}
+                "otherData": other}
 
 
 def last_report() -> Optional[QueryReport]:
@@ -782,7 +806,7 @@ def _close_trace(trace: QueryTrace, error: Optional[BaseException]) -> None:
         logger.warning(
             "slow query (%.0f ms >= DSQL_SLOW_QUERY_MS=%.0f): %s | tier: %s "
             "| cacheHit: %s | priority: %s | skew: %s | collectives: %s "
-            "| costErr: %s | phases: %s | counters: %s",
+            "| costErr: %s | phases: %s | counters: %s%s",
             report.wall_ms, slow_ms, report.query.strip()[:500],
             report.tier or "eager", bool(report.cache.get("hit")),
             report.priority or "-",
@@ -790,7 +814,10 @@ def _close_trace(trace: QueryTrace, error: Optional[BaseException]) -> None:
             report.collective_bytes or "-",
             report.cost_err if report.cost_err is not None else "-",
             {k: round(v, 1) for k, v in sorted(report.phases.items())},
-            dict(sorted(report.counters.items())))
+            dict(sorted(report.counters.items())),
+            # trace correlation suffix only when an ID exists, so the
+            # line stays byte-identical with the watchtower off
+            f" | trace: {report.trace_id}" if report.trace_id else "")
 
     _export_chrome_trace(report)
 
@@ -812,6 +839,15 @@ def _close_trace(trace: QueryTrace, error: Optional[BaseException]) -> None:
             _prof.on_query_complete(report)
         except Exception:
             logger.debug("profiler query hook failed", exc_info=True)
+
+    # watchtower (runtime/events.py): SLO fold-in + query.done event —
+    # same env-gate-before-import discipline as the two hooks above
+    if os.environ.get("DSQL_EVENTS", "0").strip() not in ("", "0"):
+        try:
+            from . import events as _ev
+            _ev.on_query_complete(report, error)
+        except Exception:
+            logger.debug("event hook failed", exc_info=True)
 
 
 @contextmanager
@@ -837,6 +873,15 @@ def trace_scope(query: str = ""):
             registered = _fr.begin_query(trace)
         except Exception:
             logger.debug("flight recorder begin failed", exc_info=True)
+    # watchtower ingress: stamp the end-to-end trace ID on the root span
+    # (server-minted / env-propagated / fresh) and publish query.begin —
+    # env gate BEFORE import, zero cost when DSQL_EVENTS is off
+    if os.environ.get("DSQL_EVENTS", "0").strip() not in ("", "0"):
+        try:
+            from . import events as _ev
+            _ev.on_trace_open(trace)
+        except Exception:
+            logger.debug("event trace-open hook failed", exc_info=True)
     err: Optional[BaseException] = None
     try:
         yield trace

@@ -79,6 +79,13 @@ ERROR_WIRE_MATRIX = {
 }
 
 
+def _events_on() -> bool:
+    """Watchtower gate (runtime/events.py): checked BEFORE any import so
+    DSQL_EVENTS=0 keeps the wire byte-identical — no trace headers, no
+    /v1/events route, no module import."""
+    return os.environ.get("DSQL_EVENTS", "0").strip() not in ("", "0")
+
+
 def submit_status(exc: Exception) -> int:
     """HTTP status for a verdict raised at the POST boundary: 503 while
     draining, 429 on saturation, 200 otherwise (the error then travels in
@@ -157,6 +164,11 @@ def _stats(state: str, info: Optional["_QueryInfo"] = None) -> dict:
             # ran the query, not a process-global snapshot)
             out["phaseMillis"] = {k: round(v, 3)
                                   for k, v in info.phases.items()}
+        # end-to-end trace ID (watchtower, DSQL_EVENTS=1): the same ID
+        # the X-DSQL-Trace header carries, so payload-only clients can
+        # still join wire stats to span trees / envelopes / events
+        if info.trace_id:
+            out["traceId"] = info.trace_id
     return out
 
 
@@ -164,7 +176,8 @@ class _QueryInfo:
     __slots__ = ("submitted", "started", "finished", "cpu_sec", "rows",
                  "bytes", "peak_memory", "compiles", "cache_hits", "phases",
                  "cache_hit", "cache_tier", "subplan_cache_hits",
-                 "queued_ms", "tier", "program_store_hits", "operators")
+                 "queued_ms", "tier", "program_store_hits", "operators",
+                 "trace_id")
 
     def __init__(self):
         self.submitted = time.monotonic()
@@ -184,12 +197,25 @@ class _QueryInfo:
         self.tier = None
         self.program_store_hits = 0
         self.operators = []
+        self.trace_id = None
 
 
 def _run_tracked(context, sql: str, info: _QueryInfo,
                  cancel: Optional[threading.Event] = None,
-                 seat: Optional[_sched.Seat] = None):
+                 seat: Optional[_sched.Seat] = None,
+                 trace_id: Optional[str] = None):
     from ..physical import compiled
+    from contextlib import nullcontext
+
+    # the ingress trace ID rides into the worker thread: trace_scope's
+    # watchtower hook picks it up and stamps the span tree, so the ID on
+    # the POST response and the ID in the trace/envelope/events agree.
+    # trace_id is only ever non-None when DSQL_EVENTS is armed.
+    if trace_id:
+        from ..runtime import events as _ev
+        tid_scope = _ev.trace_id_scope(trace_id)
+    else:
+        tid_scope = nullcontext()
 
     info.started = time.monotonic()
     c0 = dict(compiled.stats)
@@ -205,7 +231,8 @@ def _run_tracked(context, sql: str, info: _QueryInfo,
         # behind a fut.cancel() that cannot stop a started future.
         # seat_scope hands the POST-time admission pre-claim to the
         # workload manager, which consumes its timestamp + priority.
-        with _sched.seat_scope(seat), _res.query_scope(cancel=cancel):
+        with tid_scope, _sched.seat_scope(seat), \
+                _res.query_scope(cancel=cancel):
             table = context.sql(sql)
     finally:
         info.cpu_sec = time.thread_time() - cpu0
@@ -375,6 +402,7 @@ def _engine_snapshot(state: "_AppState") -> dict:
         },
         "devices": _devices_section(),
         "profile": _profile_section(),
+        "slo": _slo_section(),
     }
 
 
@@ -412,6 +440,19 @@ def _profile_section() -> dict:
         return _prof.engine_section()
     except Exception as e:
         logger.debug("profiler section unavailable: %s", e)
+        return {"enabled": False}
+
+
+def _slo_section() -> dict:
+    """Per-class SLO burn rates + live anomaly flags (runtime/events.py)
+    — imported ONLY when the watchtower is armed, like the profiler."""
+    if not _events_on():
+        return {"enabled": False}
+    try:
+        from ..runtime import events as _ev
+        return _ev.engine_section()
+    except Exception as e:
+        logger.debug("slo section unavailable: %s", e)
         return {"enabled": False}
 
 
@@ -471,6 +512,14 @@ def _drain_and_shutdown(server, state: _AppState,
     mgr.begin_drain()
     logger.warning("%s: draining server (timeout %.0f s, %d in flight)",
                    reason, timeout, len(state.future_list))
+    if _events_on():
+        try:
+            from ..runtime import events as _ev
+            _ev.publish("server.drain", reason=reason,
+                        in_flight=len(state.future_list),
+                        timeout_s=timeout)
+        except Exception:
+            pass
     try:
         with _tel.trace_scope(f"<drain:{reason}>"):
             with _tel.span("drain", reason=reason, timeout_s=timeout):
@@ -554,6 +603,28 @@ def _make_handler(state: _AppState, base_url: str):
             self.end_headers()
             self.wfile.write(body)
 
+        def _req_trace(self) -> Optional[str]:
+            """Sanitized client-supplied ``X-DSQL-Trace``, or None
+            (always None with the watchtower off — no import)."""
+            if not _events_on():
+                return None
+            from ..runtime import events as _ev
+            return _ev.sanitize_trace_id(self.headers.get("X-DSQL-Trace"))
+
+        def _trace_headers(self,
+                           info: Optional[_QueryInfo] = None,
+                           tid: Optional[str] = None) -> Optional[dict]:
+            """``X-DSQL-Trace`` response header for EVERY wire path
+            (success and the full ERROR_WIRE_MATRIX): the query's minted
+            ID when known, else the client's echoed back.  None (no
+            header at all) when the watchtower is off."""
+            if not _events_on():
+                return None
+            tid = tid or (getattr(info, "trace_id", None)
+                          if info is not None else None) or \
+                self._req_trace()
+            return {"X-DSQL-Trace": tid} if tid else None
+
         # GET /metrics | GET /v1/engine | GET /v1/empty | GET /v1/status/{uuid}
         def do_GET(self):
             if self.path.rstrip("/").split("?")[0] == "/metrics":
@@ -577,6 +648,14 @@ def _make_handler(state: _AppState, base_url: str):
                     return
                 self._send(200, payload)
                 return
+            if (self.path.rstrip("/").split("?")[0] == "/v1/events"
+                    and _events_on()):
+                # live event streaming: JSON lines newer than ?cursor=,
+                # long-polling up to ?timeout_ms= for the first arrival.
+                # With the watchtower off this path falls through to the
+                # generic 404 below — byte-identical pre-PR behavior.
+                self._serve_events()
+                return
             if self.path.rstrip("/") == "/v1/empty":
                 self._send(200, {
                     "id": "empty", "infoUri": base_url,
@@ -587,7 +666,8 @@ def _make_handler(state: _AppState, base_url: str):
                 uid = self.path[len("/v1/status/"):].strip("/")
                 fut = state.future_list.get(uid)
                 if fut is None:
-                    self._send(404, _error_payload("Unknown query id", uid))
+                    self._send(404, _error_payload("Unknown query id", uid),
+                               headers=self._trace_headers())
                     return
                 info = state.query_info.get(uid)
                 if not fut.done():
@@ -596,7 +676,7 @@ def _make_handler(state: _AppState, base_url: str):
                         "nextUri": f"{base_url}/v1/status/{uid}",
                         "partialCancelUri": f"{base_url}/v1/cancel/{uid}",
                         "stats": _stats("RUNNING", info),
-                    })
+                    }, headers=self._trace_headers(info))
                     return
                 try:
                     table = fut.result()
@@ -606,7 +686,8 @@ def _make_handler(state: _AppState, base_url: str):
                     state.cancel_events.pop(uid, None)
                     state.seats.pop(uid, None)
                     _tel.inc("server_query_errors")
-                    self._send(200, _error_payload(str(e), uid, exc=e))
+                    self._send(200, _error_payload(str(e), uid, exc=e),
+                               headers=self._trace_headers(info))
                     return
                 del state.future_list[uid]
                 state.query_info.pop(uid, None)
@@ -619,9 +700,44 @@ def _make_handler(state: _AppState, base_url: str):
                 if table is not None and table.num_columns:
                     payload["columns"] = _columns_payload(table)
                     payload["data"] = _data_payload(table)
-                self._send(200, payload)
+                self._send(200, payload,
+                           headers=self._trace_headers(info))
                 return
             self._send(404, {"error": "not found"})
+
+        def _serve_events(self):
+            """GET /v1/events?cursor=N&timeout_ms=M&limit=K — newline-
+            delimited JSON events with ``seq > cursor``; the next cursor
+            travels in ``X-DSQL-Cursor`` (and on each event's ``seq``).
+            A draining process answers immediately with whatever is
+            buffered instead of holding the long-poll open."""
+            from urllib.parse import parse_qs, urlparse
+            from ..runtime import events as _ev
+
+            q = parse_qs(urlparse(self.path).query)
+
+            def qint(name: str, default: int) -> int:
+                try:
+                    return int(q.get(name, [default])[0])
+                except (ValueError, TypeError, IndexError):
+                    return default
+
+            cursor = max(qint("cursor", 0), 0)
+            limit = min(max(qint("limit", 500), 1), 5000)
+            timeout_s = min(max(qint("timeout_ms", 0), 0) / 1e3, 30.0)
+            if _sched.get_manager().draining():
+                timeout_s = 0.0
+            evs, nxt = _ev.read_since(cursor, limit=limit,
+                                      timeout_s=timeout_s)
+            body = b"".join(
+                json.dumps(e, separators=(",", ":"), default=str).encode()
+                + b"\n" for e in evs)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("X-DSQL-Cursor", str(nxt))
+            self.end_headers()
+            self.wfile.write(body)
 
         # POST /v1/statement
         def do_POST(self):
@@ -633,13 +749,27 @@ def _make_handler(state: _AppState, base_url: str):
             _tel.inc("server_queries")
             uid = str(uuid_mod.uuid4())
             mgr = _sched.get_manager()
+            # watchtower ingress: honor the client's X-DSQL-Trace or mint
+            # one HERE, before any verdict, so success AND every
+            # ERROR_WIRE_MATRIX path return the same correlation ID.
+            # tid stays None with DSQL_EVENTS off (no header emitted).
+            tid = None
+            if _events_on():
+                from ..runtime import events as _ev
+                tid = self._req_trace() or _ev.mint_trace_id()
 
             def reject(e: _res.AdmissionRejected) -> None:
+                hdrs = {"Retry-After":
+                        str(max(int(math.ceil(e.retry_after_s)), 1))}
+                hdrs.update(self._trace_headers(tid=tid) or {})
+                if tid:
+                    from ..runtime import events as _ev
+                    _ev.publish("server.rejected", trace=tid,
+                                error=type(e).__name__,
+                                retry_after_s=round(e.retry_after_s, 3))
                 self._send(submit_status(e), _error_payload(str(e), uid,
                                                             exc=e),
-                           headers={"Retry-After":
-                                    str(max(int(math.ceil(e.retry_after_s)),
-                                            1))})
+                           headers=hdrs)
 
             # drain gate first (independent of the scheduler subsystem
             # being enabled): a draining process refuses new work with 503
@@ -664,31 +794,33 @@ def _make_handler(state: _AppState, base_url: str):
                 reject(e)
                 return
             info = _QueryInfo()
+            info.trace_id = tid
             cancel = threading.Event()
             state.query_info[uid] = info
             state.cancel_events[uid] = cancel
             if seat is not None:
                 state.seats[uid] = seat
             fut = state.pool.submit(_run_tracked, state.context, sql, info,
-                                    cancel, seat)
+                                    cancel, seat, tid)
             state.future_list[uid] = fut
             self._send(200, {
                 "id": uid, "infoUri": base_url,
                 "nextUri": f"{base_url}/v1/status/{uid}",
                 "partialCancelUri": f"{base_url}/v1/cancel/{uid}",
                 "stats": _stats("QUEUED", info),
-            })
+            }, headers=self._trace_headers(tid=tid))
 
         # DELETE /v1/cancel/{uuid}
         def do_DELETE(self):
             if self.path.startswith("/v1/cancel/"):
                 uid = self.path[len("/v1/cancel/"):].strip("/")
                 fut = state.future_list.pop(uid, None)
-                state.query_info.pop(uid, None)
+                info = state.query_info.pop(uid, None)
                 cancel = state.cancel_events.pop(uid, None)
                 seat = state.seats.pop(uid, None)
                 if fut is None:
-                    self._send(404, _error_payload("Unknown query id", uid))
+                    self._send(404, _error_payload("Unknown query id", uid),
+                               headers=self._trace_headers())
                     return
                 # a query cancelled while still in the pool backlog never
                 # reaches _run_tracked — its admission pre-claim must not
@@ -704,7 +836,11 @@ def _make_handler(state: _AppState, base_url: str):
                     cancel.set()
                 fut.cancel()
                 _tel.inc("server_cancels")
-                self._send(200, None)
+                tid = getattr(info, "trace_id", None)
+                if tid and _events_on():
+                    from ..runtime import events as _ev
+                    _ev.publish("server.cancel", trace=tid, id=uid)
+                self._send(200, None, headers=self._trace_headers(tid=tid))
                 return
             self._send(404, {"error": "not found"})
 

@@ -106,6 +106,13 @@ echo "=== [2m] matview smoke (incremental view maintenance) ==="
 # and DSQL_MV=0 must restore pre-subsystem behavior
 python scripts/mv_smoke.py
 
+echo "=== [2n] events smoke (watchtower: traces, bus, SLO burn) ==="
+# one trace ID must round-trip client -> wire -> span tree -> envelope ->
+# system.events (a child process included), /v1/events must stream with
+# a working cursor, a deliberately slow query must trip the interactive
+# burn-rate gauge, and DSQL_EVENTS=0 must never even import the bus
+python scripts/events_smoke.py
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \

@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Watchtower smoke gate: trace IDs, the event bus, and SLO burn rates.
+
+Run by scripts/ci_local.sh (mirroring scripts/profile_smoke.py):
+
+    python scripts/events_smoke.py
+
+With ``DSQL_EVENTS=1`` armed the gate proves
+
+  1. one trace ID round-trips client -> server wire -> span tree ->
+     flight-recorder envelope -> ``system.events`` — including a query
+     run in a CHILD process against the shared history/events files;
+  2. ``GET /v1/events`` streams the correlated events with a working
+     cursor;
+  3. a deliberately slow query (1 ms interactive objective) trips the
+     interactive burn-rate gauge and the ``slo`` section on
+     ``GET /v1/engine`` flags the breach;
+  4. the disabled path is ZERO-cost: a child process with
+     ``DSQL_EVENTS=0`` never imports ``runtime.events``, answers
+     without trace headers, serves the generic 404 on ``/v1/events``,
+     and returns bit-identical query results.
+
+Exit 0 on success.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["DSQL_EVENTS"] = "1"
+os.environ["DSQL_ADAPTIVE"] = "0"
+os.environ.setdefault("DSQL_TIERED", "0")
+
+_TMP = tempfile.mkdtemp(prefix="dsql_events_")
+os.environ["DSQL_EVENTS_FILE"] = os.path.join(_TMP, "events.jsonl")
+os.environ["DSQL_HISTORY_FILE"] = os.path.join(_TMP, "history.jsonl")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dask_sql_tpu import Context  # noqa: E402
+from dask_sql_tpu.runtime import events as ev  # noqa: E402
+from dask_sql_tpu.runtime import flight_recorder as fr  # noqa: E402
+from dask_sql_tpu.runtime import telemetry as tel  # noqa: E402
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _req(url, body=None, headers=None):
+    req = urllib.request.Request(
+        url, data=body.encode() if body is not None else None,
+        headers=headers or {})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read() or b"null"), dict(r.headers)
+
+
+def _finish(payload):
+    while "nextUri" in payload:
+        payload, _ = _req(payload["nextUri"])
+    return payload
+
+
+def main() -> int:
+    ctx = Context()
+    ctx.create_table("t", {"a": list(range(16))})
+    srv = ctx.run_server(host="127.0.0.1", port=0, blocking=False)
+    base = f"http://127.0.0.1:{srv.server_port}"
+    try:
+        # -- 1. end-to-end trace correlation ---------------------------------
+        payload, hdrs = _req(f"{base}/v1/statement",
+                             "SELECT SUM(a) AS s FROM t",
+                             headers={"X-DSQL-Trace": "smoke-trace-1"})
+        if hdrs.get("X-DSQL-Trace") != "smoke-trace-1":
+            return fail(f"POST did not echo the trace header: {hdrs}")
+        final = _finish(payload)
+        if final.get("data") != [[120]]:
+            return fail(f"wrong result: {final}")
+        if final["stats"].get("traceId") != "smoke-trace-1":
+            return fail(f"wire stats missing traceId: {final['stats']}")
+        envs = [e for e in fr.read_events(kind="query")
+                if e.get("trace") == "smoke-trace-1"]
+        if not envs:
+            return fail("flight-recorder envelope missing the trace ID")
+        report = tel.last_report()  # server ran in-process worker threads
+        types = {e["type"] for e in ev._read_file(
+            os.environ["DSQL_EVENTS_FILE"])
+            if e.get("trace") == "smoke-trace-1"}
+        if not {"query.begin", "query.done"} <= types:
+            return fail(f"bus events incomplete for the trace: {types}")
+        print("ok trace: wire + envelope + bus agree on smoke-trace-1"
+              + (f" (report {report.trace_id})"
+                 if report is not None and report.trace_id else ""))
+
+        # child process: same files, pinned trace ID, correlated from here
+        child = (
+            "from dask_sql_tpu import Context\n"
+            "c = Context()\n"
+            "c.create_table('t', {'a': [7, 8, 9]})\n"
+            "assert c.sql('SELECT SUM(a) AS s FROM t'"
+            ").to_pylist() == [[24]]\n"
+        )
+        env = dict(os.environ, DSQL_TRACE_ID="smoke-xproc-2",
+                   DSQL_MAX_CONCURRENT_QUERIES="0",
+                   DSQL_RESULT_CACHE_MB="0")
+        proc = subprocess.run([sys.executable, "-c", child], env=env,
+                              capture_output=True, timeout=600)
+        if proc.returncode != 0:
+            return fail(f"child query: {proc.stderr.decode()[-500:]}")
+        rows = ctx.sql("SELECT count(*) AS n FROM system.events "
+                       "WHERE trace = 'smoke-xproc-2'",
+                       return_futures=False)
+        n = int(rows["n"][0])
+        if n < 2:
+            return fail(f"system.events joined {n} child rows, want >= 2")
+        xenvs = [e for e in fr.read_events(kind="query")
+                 if e.get("trace") == "smoke-xproc-2"]
+        if len(xenvs) != 1 or xenvs[0]["pid"] == os.getpid():
+            return fail(f"child envelope wrong: {xenvs}")
+        print(f"ok cross-process: child pid {xenvs[0]['pid']} correlated "
+              f"via system.events ({n} rows)")
+
+        # -- 2. /v1/events cursor stream -------------------------------------
+        with urllib.request.urlopen(f"{base}/v1/events?cursor=0&limit=999",
+                                    timeout=60) as r:
+            cursor = int(r.headers["X-DSQL-Cursor"])
+            lines = [json.loads(l) for l in r.read().splitlines() if l]
+        if cursor <= 0 or not any(e["type"] == "query.done"
+                                  for e in lines):
+            return fail(f"/v1/events stream dead: cursor={cursor}")
+        with urllib.request.urlopen(f"{base}/v1/events?cursor={cursor}",
+                                    timeout=60) as r:
+            if r.read() != b"":
+                return fail("cursor resume returned stale events")
+        print(f"ok /v1/events: {len(lines)} events, cursor {cursor}")
+
+        # -- 3. slow query trips the interactive burn gauge ------------------
+        os.environ["DSQL_SLO_INTERACTIVE_MS"] = "1"   # everything breaches
+        try:
+            payload, _ = _req(f"{base}/v1/statement",
+                              "SELECT a, SUM(a) AS s FROM t GROUP BY a")
+            _finish(payload)
+        finally:
+            del os.environ["DSQL_SLO_INTERACTIVE_MS"]
+        burn = tel.REGISTRY.gauges().get("slo_burn_fast_interactive", 0.0)
+        if burn <= 2.0:
+            return fail(f"slow query did not trip the burn gauge: {burn}")
+        snap, _ = _req(f"{base}/v1/engine")
+        slo = snap.get("slo", {})
+        if not slo.get("enabled"):
+            return fail(f"/v1/engine slo section missing: {sorted(snap)}")
+        inter = [r for r in slo["classes"]
+                 if r["class"] == "interactive"][0]
+        if inter["breaches"] < 1:
+            return fail(f"slo section shows no breach: {inter}")
+        kinds = {a["kind"] for a in slo["anomalies"]}
+        print(f"ok slo: burn_fast={burn:.1f} breaches={inter['breaches']} "
+              f"anomalies={sorted(kinds) or 'none'}")
+    finally:
+        srv.shutdown()
+        ctx.server = None
+
+    # -- 4. disabled path: zero imports, no headers, identical results ------
+    child_code = (
+        "import json, sys, urllib.request\n"
+        "from dask_sql_tpu import Context\n"
+        "c = Context()\n"
+        "c.create_table('t', {'a': [1, 2, 3, 4]})\n"
+        "r1 = c.sql('SELECT SUM(a) AS s FROM t').to_pylist()\n"
+        "assert r1 == [[10]], r1\n"
+        "assert 'dask_sql_tpu.runtime.events' not in sys.modules, \\\n"
+        "    'events imported with DSQL_EVENTS=0'\n"
+        "srv = c.run_server(host='127.0.0.1', port=0, blocking=False)\n"
+        "base = f'http://127.0.0.1:{srv.server_port}'\n"
+        "req = urllib.request.Request(base + '/v1/statement',\n"
+        "    data=b'SELECT SUM(a) AS s FROM t',\n"
+        "    headers={'X-DSQL-Trace': 'must-be-ignored'})\n"
+        "with urllib.request.urlopen(req) as r:\n"
+        "    p = json.loads(r.read())\n"
+        "    assert 'X-DSQL-Trace' not in r.headers, dict(r.headers)\n"
+        "while 'nextUri' in p:\n"
+        "    with urllib.request.urlopen(p['nextUri']) as r:\n"
+        "        p = json.loads(r.read())\n"
+        "assert p['data'] == [[10]], p\n"
+        "assert 'traceId' not in p['stats'], p['stats']\n"
+        "try:\n"
+        "    urllib.request.urlopen(base + '/v1/events')\n"
+        "    raise SystemExit('/v1/events served while disabled')\n"
+        "except urllib.error.HTTPError as e:\n"
+        "    assert e.code == 404, e.code\n"
+        "assert 'dask_sql_tpu.runtime.events' not in sys.modules\n"
+        "srv.shutdown()\n"
+        "print('child ok')\n"
+    )
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("DSQL_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DSQL_EVENTS"] = "0"
+    proc = subprocess.run([sys.executable, "-c", child_code], env=env,
+                          capture_output=True, timeout=600)
+    if proc.returncode != 0 or b"child ok" not in proc.stdout:
+        return fail(f"disabled-path child: {proc.stderr.decode()[-800:]}")
+    print("ok disabled path: zero events imports, no trace surface, "
+          "identical results")
+
+    print("events smoke PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

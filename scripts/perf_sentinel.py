@@ -46,6 +46,10 @@ HEADLINE_SCHEMA = 1
 LOWER_BETTER = ("warm_exec_geomean_sec", "first_arrival_sec")
 HIGHER_BETTER = ("program_store_hit_rate", "vs_pandas_geomean")
 NO_INCREASE = ("compile_errors",)
+# headline fields shown as context but NEVER gated on: the watchtower's
+# per-class SLO attainment depends on the burst pass's load shape, so a
+# band would flap — operators read the trend, the sentinel only displays
+INFORMATIONAL = ("slo_attainment",)
 
 # the wall-clock metric name bench.py has emitted since PR 6; artifacts
 # with a different ``metric`` (r01's rows/sec era) contribute no
@@ -111,6 +115,7 @@ def extract_headline(doc: dict) -> Optional[Dict[str, object]]:
         vb = obj.get("vs_baseline")
         vsp = float(vb) if isinstance(vb, (int, float)) and vb > 0 else None
     out["vs_pandas_geomean"] = vsp
+    out["slo_attainment"] = det.get("slo_attainment")
     cs = det.get("compiled_stats") or {}
     ce = cs.get("compile_errors") if isinstance(cs, dict) else None
     out["compile_errors"] = int(ce) if ce is not None else None
@@ -251,6 +256,20 @@ def _render(report: dict) -> str:
         lines.append(f"  [{mark}] {row['metric']}: "
                      f"{row['baseline']:g} -> {row['current']:g} "
                      f"(band {row['band']})")
+    for key in INFORMATIONAL:
+        b = (report.get("baseline_headline") or {}).get(key)
+        c = (report.get("current_headline") or {}).get(key)
+        if b is None and c is None:
+            continue
+
+        def fmt(v):
+            if isinstance(v, dict):
+                return "{" + ", ".join(f"{k}={v[k]:g}"
+                                       for k in sorted(v)) + "}"
+            return "n/a" if v is None else f"{v:g}"
+
+        lines.append(f"  [info] {key}: {fmt(b)} -> {fmt(c)} "
+                     f"(informational, non-gating)")
     lines.append(f"  status: {report['status']}")
     return "\n".join(lines)
 

@@ -184,6 +184,21 @@ def _profiler_off(request, monkeypatch):
 
 
 @pytest.fixture(autouse=True)
+def _events_off(request, monkeypatch):
+    """The watchtower event bus + SLO monitor (runtime/events.py) is
+    env-armed like the profiler; an operator's DSQL_EVENTS must not arm
+    trace minting, event publication or SLO gauges in unrelated suites
+    (or break the zero-import tripwire test).  Off by default, armed
+    explicitly by the dedicated events suites, and
+    scripts/events_smoke.py gates the production path."""
+    if "event" not in request.module.__name__:
+        monkeypatch.delenv("DSQL_EVENTS", raising=False)
+        monkeypatch.delenv("DSQL_EVENTS_FILE", raising=False)
+        monkeypatch.delenv("DSQL_TRACE_ID", raising=False)
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _mesh_off(request, monkeypatch):
     """The SPMD multi-chip backend (parallel/spmd.py, on by default when a
     context carries a mesh) intercepts mesh-context queries before the

@@ -1,0 +1,137 @@
+"""GET /v1/engine and GET /metrics under concurrent mixed-priority load
+(ISSUE 15 satellite): the snapshot never throws mid-mutation, counters
+stay monotonic poll-over-poll, and gauges stay inside the configured
+scheduler bounds while 2-slot admission churns 12 client threads."""
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def server(monkeypatch):
+    monkeypatch.setenv("DSQL_MAX_CONCURRENT_QUERIES", "2")
+    monkeypatch.setenv("DSQL_QUEUE_DEPTH", "16")
+    monkeypatch.setenv("DSQL_EVENTS", "1")
+    from dask_sql_tpu.context import Context
+    from dask_sql_tpu.runtime import events as ev
+    from dask_sql_tpu.server.app import run_server
+
+    ev._reset_for_tests()
+    context = Context()
+    context.create_table("t", {"a": np.arange(64, dtype=np.int64),
+                               "g": np.arange(64, dtype=np.int64) % 8})
+    srv = run_server(context=context, host="127.0.0.1", port=0,
+                     blocking=False)
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+    ev._reset_for_tests()
+
+
+def _get_raw(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.read()
+
+
+def _scrape(text):
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        m = re.match(r"^(\w+)(?:\{[^}]*\})?\s+([-\d.e+]+)$", line)
+        if m:
+            out[m.group(1)] = float(m.group(2))
+    return out
+
+
+def test_snapshots_survive_concurrent_mixed_priority_load(server):
+    base = server
+    queries = ["SELECT SUM(a) AS s FROM t",
+               "SELECT g, COUNT(*) AS n FROM t GROUP BY g",
+               "SELECT MAX(a) AS m FROM t WHERE a > 3"]
+    priorities = ["interactive", "batch", "background"]
+    errors = []
+    done = threading.Event()
+
+    def client(i):
+        try:
+            for j in range(4):
+                body = queries[(i + j) % 3].encode()
+                req = urllib.request.Request(
+                    f"{base}/v1/statement", data=body,
+                    headers={"X-DSQL-Priority": priorities[i % 3]},
+                    method="POST")
+                try:
+                    with urllib.request.urlopen(req, timeout=60) as r:
+                        payload = json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    # 429 under a full queue is a legal verdict here
+                    assert e.code in (429, 503), e.code
+                    continue
+                while "nextUri" in payload:
+                    with urllib.request.urlopen(payload["nextUri"],
+                                                timeout=60) as r:
+                        payload = json.loads(r.read())
+                assert "data" in payload or "error" in payload
+        except Exception as e:                       # pragma: no cover
+            errors.append(e)
+
+    def poller():
+        try:
+            _poll_loop()
+        except Exception as e:
+            errors.append(e)
+
+    def _poll_loop():
+        """Hammer both read surfaces while the load runs; every
+        response must parse and respect the invariants."""
+        last_queries = -1.0
+        last_published = -1.0
+        while not done.is_set():
+            snap = json.loads(_get_raw(f"{base}/v1/engine"))
+            sched = snap["scheduler"]
+            assert sched["enabled"] is True
+            assert 0 <= sched["running"] <= 2
+            assert sched["queueDepth"] <= 2 + 16
+            assert snap["slo"]["enabled"] is True
+            for row in snap["slo"]["classes"]:
+                assert 0.0 <= row["attainment"] <= 1.0
+                assert row["burn_fast"] >= 0.0
+            mets = _scrape(_get_raw(f"{base}/metrics").decode())
+            q = mets.get("dsql_server_queries_total", 0.0)
+            assert q >= last_queries          # counters only go up
+            last_queries = q
+            p = mets.get("dsql_events_published_total", 0.0)
+            assert p >= last_published
+            last_published = p
+            g = mets.get("dsql_sched_queue_depth")
+            if g is not None:
+                assert 0 <= g <= 2 + 16
+            for cls in ("interactive", "batch", "background"):
+                att = mets.get(f"dsql_slo_attainment_{cls}")
+                if att is not None:
+                    assert 0.0 <= att <= 1.0
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(12)]
+    pollers = [threading.Thread(target=poller) for _ in range(2)]
+    for t in pollers + threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    done.set()
+    for t in pollers:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert not any(t.is_alive() for t in threads + pollers)
+
+    # quiesced: the final snapshot agrees with itself
+    snap = json.loads(_get_raw(f"{base}/v1/engine"))
+    assert snap["scheduler"]["running"] == 0
+    total = sum(r["total"] for r in snap["slo"]["classes"])
+    assert total >= 1

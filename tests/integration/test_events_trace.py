@@ -1,0 +1,202 @@
+"""End-to-end watchtower correlation (ISSUE 15 acceptance): one trace ID
+follows a query across the server wire, the span tree, the
+flight-recorder envelope, and system.events — including a query run in a
+CHILD process against shared history/events files — plus the
+/v1/events long-poll endpoint and trace headers on the error paths."""
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def server(tmp_path, monkeypatch):
+    monkeypatch.setenv("DSQL_EVENTS", "1")
+    monkeypatch.setenv("DSQL_EVENTS_FILE", str(tmp_path / "events.jsonl"))
+    monkeypatch.setenv("DSQL_HISTORY_FILE", str(tmp_path / "hist.jsonl"))
+    from dask_sql_tpu.context import Context
+    from dask_sql_tpu.runtime import events as ev
+    from dask_sql_tpu.server.app import run_server
+
+    ev._reset_for_tests()
+    context = Context()
+    context.create_table("t", {"a": np.arange(8, dtype=np.int64)})
+    srv = run_server(context=context, host="127.0.0.1", port=0,
+                     blocking=False)
+    yield f"http://127.0.0.1:{srv.server_port}", str(tmp_path)
+    srv.shutdown()
+    ev._reset_for_tests()
+
+
+def _req(url, body=None, headers=None, method=None):
+    req = urllib.request.Request(
+        url, data=body.encode() if body is not None else None,
+        headers=headers or {}, method=method)
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read() or b"null"), dict(r.headers)
+
+
+def _run_to_completion(base, payload):
+    deadline = time.time() + 120
+    while "nextUri" in payload and time.time() < deadline:
+        time.sleep(0.02)
+        payload, _ = _req(payload["nextUri"])
+    return payload
+
+
+def test_trace_id_minted_and_correlated(server):
+    base, tmp = server
+    payload, headers = _req(f"{base}/v1/statement",
+                            "SELECT SUM(a) AS s FROM t")
+    tid = headers.get("X-DSQL-Trace")
+    assert tid, "POST response missing the minted trace header"
+    final = _run_to_completion(base, payload)
+    assert final["data"] == [[28]]
+    assert final["stats"]["traceId"] == tid       # wire stats surface
+    # flight-recorder envelope carries the same ID
+    from dask_sql_tpu.runtime import flight_recorder as fr
+    envs = [e for e in fr.read_events(kind="query")
+            if e.get("trace") == tid]
+    assert envs and envs[0]["outcome"] == "ok"
+    # ... and so do the bus events, begin through done
+    from dask_sql_tpu.runtime import events as ev
+    types = {e["type"] for e in ev._read_file(
+        os.path.join(tmp, "events.jsonl")) if e.get("trace") == tid}
+    assert {"query.begin", "query.done"} <= types
+
+
+def test_client_supplied_trace_id_roundtrips(server):
+    base, _ = server
+    payload, headers = _req(f"{base}/v1/statement", "SELECT 1 AS one",
+                            headers={"X-DSQL-Trace": "client-chosen-42"})
+    assert headers.get("X-DSQL-Trace") == "client-chosen-42"
+    final = _run_to_completion(base, payload)
+    assert final["stats"]["traceId"] == "client-chosen-42"
+
+
+def test_invalid_client_trace_id_is_replaced(server):
+    base, _ = server
+    _, headers = _req(f"{base}/v1/statement", "SELECT 1 AS one",
+                      headers={"X-DSQL-Trace": "bad id;DROP"})
+    tid = headers.get("X-DSQL-Trace")
+    assert tid and tid != "bad id;DROP" and len(tid) == 16
+
+
+def test_error_path_carries_trace_header(server):
+    base, _ = server
+    payload, headers = _req(f"{base}/v1/statement",
+                            "SELECT nosuchcolumn FROM t",
+                            headers={"X-DSQL-Trace": "err-trace-1"})
+    assert headers.get("X-DSQL-Trace") == "err-trace-1"
+    final = _run_to_completion(base, payload)
+    assert "error" in final
+    # unknown-id status poll still answers with a header (no info row)
+    try:
+        _req(f"{base}/v1/status/not-a-real-id")
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+        assert e.headers.get("X-DSQL-Trace") is None  # nothing to echo
+
+
+def test_events_endpoint_streams_with_cursor(server):
+    base, _ = server
+    payload, headers = _req(f"{base}/v1/statement", "SELECT MAX(a) FROM t")
+    _run_to_completion(base, payload)
+    req = urllib.request.Request(f"{base}/v1/events?cursor=0&limit=1000")
+    with urllib.request.urlopen(req) as r:
+        assert r.headers["Content-Type"] == "application/x-ndjson"
+        cursor = int(r.headers["X-DSQL-Cursor"])
+        lines = [json.loads(ln) for ln in r.read().splitlines() if ln]
+    assert cursor > 0
+    assert any(e["type"] == "query.done" for e in lines)
+    assert all(e["seq"] <= cursor for e in lines)
+    # resuming at the returned cursor yields nothing new
+    with urllib.request.urlopen(
+            f"{base}/v1/events?cursor={cursor}") as r:
+        assert r.read() == b""
+        assert int(r.headers["X-DSQL-Cursor"]) == cursor
+
+
+def test_trace_correlates_across_processes(server):
+    """The acceptance proof: a CHILD process runs a query with a pinned
+    DSQL_TRACE_ID against the SHARED history/events files; this process
+    then joins the envelope and the events ring on that one ID."""
+    base, tmp = server
+    code = (
+        "from dask_sql_tpu import Context\n"
+        "c = Context()\n"
+        "c.create_table('t', {'a': [10, 20, 30]})\n"
+        "assert c.sql('SELECT SUM(a) AS s FROM t').to_pylist() == [[60]]\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DSQL_TIERED="0",
+               DSQL_MAX_CONCURRENT_QUERIES="0", DSQL_RESULT_CACHE_MB="0",
+               DSQL_TRACE_ID="xproc-trace-7")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr.decode()
+
+    from dask_sql_tpu.runtime import events as ev
+    from dask_sql_tpu.runtime import flight_recorder as fr
+    envs = [e for e in fr.read_events(kind="query")
+            if e.get("trace") == "xproc-trace-7"]
+    assert len(envs) == 1 and envs[0]["pid"] != os.getpid()
+    recs = [e for e in ev._read_file(os.path.join(tmp, "events.jsonl"))
+            if e.get("trace") == "xproc-trace-7"]
+    types = {e["type"] for e in recs}
+    assert {"query.begin", "query.done"} <= types
+    assert all(e["pid"] != os.getpid() for e in recs)
+    # the same join through SQL: system.events rows carry the child's ID
+    from dask_sql_tpu.context import Context
+    c = Context()
+    rows = c.sql("SELECT count(*) AS n FROM system.events "
+                 "WHERE trace = 'xproc-trace-7'").to_pylist()
+    assert rows[0][0] >= 2
+
+
+def test_engine_snapshot_has_slo_section(server):
+    base, _ = server
+    payload, _ = _req(f"{base}/v1/statement", "SELECT COUNT(*) FROM t")
+    _run_to_completion(base, payload)
+    snap, _ = _req(f"{base}/v1/engine")
+    slo = snap["slo"]
+    assert slo["enabled"] is True
+    classes = {r["class"]: r for r in slo["classes"]}
+    assert classes["interactive"]["total"] >= 1
+    assert isinstance(slo["anomalies"], list)
+    assert slo["bus"]["seq"] > 0
+
+
+def test_disabled_server_has_no_trace_surface(tmp_path, monkeypatch):
+    """DSQL_EVENTS off: no headers, no stats field, /v1/events is the
+    generic 404 — the wire is bit-identical to pre-watchtower."""
+    monkeypatch.delenv("DSQL_EVENTS", raising=False)
+    from dask_sql_tpu.context import Context
+    from dask_sql_tpu.server.app import run_server
+
+    context = Context()
+    context.create_table("t", {"a": np.arange(4, dtype=np.int64)})
+    srv = run_server(context=context, host="127.0.0.1", port=0,
+                     blocking=False)
+    base = f"http://127.0.0.1:{srv.server_port}"
+    try:
+        payload, headers = _req(f"{base}/v1/statement",
+                                "SELECT SUM(a) AS s FROM t",
+                                headers={"X-DSQL-Trace": "ignored"})
+        assert "X-DSQL-Trace" not in headers
+        final = _run_to_completion(base, payload)
+        assert final["data"] == [[6]]
+        assert "traceId" not in final["stats"]
+        try:
+            _req(f"{base}/v1/events")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.shutdown()

@@ -1,0 +1,324 @@
+"""Watchtower unit coverage (runtime/events.py): the bounded event bus
+and its cursor semantics, trace-ID minting/sanitizing/resolution, the
+crash-tolerant JSONL ring, the SLO burn-rate monitor with edge-triggered
+breaches, anomaly flags, the system.events/system.slo tables, and the
+zero-import disabled path."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dask_sql_tpu.runtime import telemetry as tel
+
+
+@pytest.fixture()
+def ev(monkeypatch):
+    """Armed watchtower with a fresh bus/monitor per test."""
+    monkeypatch.setenv("DSQL_EVENTS", "1")
+    from dask_sql_tpu.runtime import events
+    events._reset_for_tests()
+    yield events
+    events._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# the bus
+# ---------------------------------------------------------------------------
+
+def test_publish_and_cursor_semantics(ev):
+    before = tel.REGISTRY.get("events_published")
+    ev.publish("a.one", x=1)
+    ev.publish("a.two", x=2)
+    ev.publish("a.three", x=3)
+    assert tel.REGISTRY.get("events_published") == before + 3
+    evs, nxt = ev.read_since(0)
+    assert [e["type"] for e in evs] == ["a.one", "a.two", "a.three"]
+    assert nxt == evs[-1]["seq"]
+    # cursor resumes AFTER what was read
+    evs2, nxt2 = ev.read_since(nxt)
+    assert evs2 == [] and nxt2 == nxt
+    ev.publish("a.four")
+    evs3, _ = ev.read_since(nxt)
+    assert [e["type"] for e in evs3] == ["a.four"]
+    # limit caps the batch, cursor still advances batch-by-batch
+    evs4, n4 = ev.read_since(0, limit=2)
+    assert len(evs4) == 2 and n4 == evs4[-1]["seq"]
+
+
+def test_ring_is_bounded(ev, monkeypatch):
+    monkeypatch.setenv("DSQL_EVENTS_RING", "16")
+    ev._reset_for_tests()  # bus re-reads the ring size
+    for i in range(100):
+        ev.publish("tick", i=i)
+    snap = ev.get_bus().snapshot()
+    assert len(snap) == 16
+    assert snap[-1]["i"] == 99           # newest survive
+    assert snap[0]["i"] == 84            # oldest evicted
+    # a cursor older than the ring skips the evicted range cleanly
+    evs, _ = ev.read_since(0, limit=1000)
+    assert [e["i"] for e in evs] == list(range(84, 100))
+
+
+def test_long_poll_wakes_on_publish(ev):
+    cur = ev.get_bus().last_seq()
+    got = []
+
+    def waiter():
+        evs, _ = ev.read_since(cur, timeout_s=5.0)
+        got.extend(evs)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    ev.publish("wake.up")
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert [e["type"] for e in got] == ["wake.up"]
+
+
+def test_publish_never_raises(ev, monkeypatch):
+    before = tel.REGISTRY.get("events_dropped")
+
+    def boom(rec):
+        raise RuntimeError("bus on fire")
+
+    monkeypatch.setattr(ev.get_bus(), "append", boom)
+    assert ev.publish("doomed") is None
+    assert tel.REGISTRY.get("events_dropped") == before + 1
+
+
+def test_core_field_collisions_are_stripped(ev):
+    rec = ev.publish("t", seq=999, pid=-1, unix=-1.0, type="fake", x=7)
+    assert rec["type"] == "t" and rec["pid"] == os.getpid()
+    assert rec["x"] == 7 and rec["seq"] != 999
+
+
+# ---------------------------------------------------------------------------
+# trace IDs
+# ---------------------------------------------------------------------------
+
+def test_mint_and_sanitize(ev):
+    tid = ev.mint_trace_id()
+    assert len(tid) == 16 and ev.sanitize_trace_id(tid) == tid
+    assert ev.mint_trace_id() != tid
+    assert ev.sanitize_trace_id("abc-DEF_123") == "abc-DEF_123"
+    assert ev.sanitize_trace_id("  padded  ") == "padded"  # stripped
+    assert ev.sanitize_trace_id("x" * 65) is None
+    assert ev.sanitize_trace_id("inj\nected") is None
+    assert ev.sanitize_trace_id("semi;colon") is None
+    assert ev.sanitize_trace_id("") is None
+    assert ev.sanitize_trace_id(None) is None
+
+
+def test_trace_id_resolution_order(ev, monkeypatch):
+    assert ev.current_trace_id() is None
+    monkeypatch.setenv("DSQL_TRACE_ID", "from-env")
+    assert ev.current_trace_id() == "from-env"
+    with ev.trace_id_scope("from-scope"):
+        assert ev.current_trace_id() == "from-scope"
+        rec = ev.publish("inside")
+        assert rec["trace"] == "from-scope"
+    assert ev.current_trace_id() == "from-env"
+    # invalid env ID resolves to None, not garbage
+    monkeypatch.setenv("DSQL_TRACE_ID", "bad id!")
+    assert ev.current_trace_id() is None
+
+
+def test_trace_rides_span_tree_into_report(ev):
+    """on_trace_open stamps the root attr; QueryReport picks it up."""
+    with tel.trace_scope("SELECT 1") as trace:
+        tid = trace.root.attrs.get("trace_id")
+        assert tid and ev.sanitize_trace_id(tid) == tid
+    report = tel.last_report()
+    assert report.trace_id == tid
+    assert report.to_dict()["trace_id"] == tid
+    # chrome-trace export carries it in the trace-level metadata
+    assert report.to_chrome_trace()["otherData"]["trace_id"] == tid
+    # ... and the completion landed on the bus with the same ID
+    done = [e for e in ev.get_bus().snapshot() if e["type"] == "query.done"]
+    assert done and done[-1]["trace"] == tid
+
+
+# ---------------------------------------------------------------------------
+# the JSONL file ring
+# ---------------------------------------------------------------------------
+
+def test_file_ring_truncates_at_limit(ev, tmp_path, monkeypatch):
+    path = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv("DSQL_EVENTS_FILE", path)
+    monkeypatch.setenv("DSQL_EVENTS_MB", "0.001")  # floor clamps to 4096
+    assert ev.file_limit_bytes() == 4096
+    pad = "x" * 100
+    for i in range(200):
+        ev.publish("churn", i=i, pad=pad)
+    assert os.path.getsize(path) <= 4096
+    recs = ev._read_file(path)
+    assert recs and recs[-1]["i"] == 199      # newest kept
+    assert recs[0]["i"] > 0                   # oldest dropped
+
+
+def test_file_ring_skips_corrupt_lines(ev, tmp_path, monkeypatch):
+    path = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv("DSQL_EVENTS_FILE", path)
+    ev.publish("good", i=1)
+    with open(path, "ab") as f:
+        f.write(b"not json\n")
+        f.write(b'{"torn": tru')       # torn mid-write
+        f.write(b"\n[1, 2, 3]\n")      # json but not a dict
+    ev.publish("good", i=2)
+    assert [r["i"] for r in ev._read_file(path)] == [1, 2]
+
+
+def test_events_rows_compacts_extras(ev, tmp_path, monkeypatch):
+    ev.publish("shape.test", zeta=1, alpha="two")
+    row = ev.events_rows()[-1]
+    assert row["type"] == "shape.test"
+    assert json.loads(row["detail"]) == {"alpha": "two", "zeta": 1}
+    assert set(row) == {"seq", "unix", "pid", "trace", "type", "detail"}
+
+
+# ---------------------------------------------------------------------------
+# SLO monitor
+# ---------------------------------------------------------------------------
+
+def test_slo_knob_parsing(ev, monkeypatch):
+    assert ev.objective_ms("interactive") == 1000.0
+    assert ev.objective_ms("batch") == 10000.0
+    assert ev.objective_ms("background") == 60000.0
+    monkeypatch.setenv("DSQL_SLO_INTERACTIVE_MS", "250")
+    assert ev.objective_ms("interactive") == 250.0
+    assert ev.slo_target() == 0.99
+    monkeypatch.setenv("DSQL_SLO_TARGET", "2.0")
+    assert ev.slo_target() == 0.9999           # clamped
+    monkeypatch.setenv("DSQL_SLO_TARGET", "not-a-number")
+    assert ev.slo_target() == 0.99
+
+
+def test_slo_attainment_and_gauges(ev):
+    mon = ev.get_monitor()
+    mon.observe("interactive", 10.0)           # within 1000ms objective
+    mon.observe("interactive", 5000.0)         # breach
+    rows = {r["class"]: r for r in ev.slo_rows()}
+    r = rows["interactive"]
+    assert r["total"] == 2 and r["breaches"] == 1
+    assert r["attainment"] == pytest.approx(0.5)
+    assert tel.REGISTRY.gauges()["slo_attainment_interactive"] == \
+        pytest.approx(0.5)
+    # burn = breach_fraction / (1 - target) = 0.5 / 0.01 = 50
+    assert r["burn_fast"] == pytest.approx(50.0)
+    # untouched classes report clean
+    assert rows["batch"]["total"] == 0
+    assert rows["batch"]["attainment"] == 1.0
+
+
+def test_slo_breach_is_edge_triggered(ev):
+    before = tel.REGISTRY.get("slo_breaches")
+    mon = ev.get_monitor()
+    mon.observe("batch", 99999.0)              # 100% breach, burn 100x
+    mon.observe("batch", 99999.0)              # still breaching: no re-fire
+    mon.observe("batch", 99999.0)
+    assert tel.REGISTRY.get("slo_breaches") == before + 1
+    breaches = [e for e in ev.get_bus().snapshot()
+                if e["type"] == "slo.breach"]
+    assert len(breaches) == 1 and breaches[0]["cls"] == "batch"
+    assert "batch" in ev.get_monitor().breached_classes()
+
+
+def test_unknown_priority_maps_to_interactive(ev):
+    mon = ev.get_monitor()
+    mon.observe(None, 1.0)
+    mon.observe("mystery", 1.0)
+    rows = {r["class"]: r for r in ev.slo_rows()}
+    assert rows["interactive"]["total"] == 2
+
+
+# ---------------------------------------------------------------------------
+# anomaly flags
+# ---------------------------------------------------------------------------
+
+def test_compile_error_spike_flag(ev):
+    ev._sample_counters(time.time() - 1.0)     # baseline sample
+    tel.inc("compile_errors", 5)
+    flags = ev.anomalies()
+    spike = [f for f in flags if f["kind"] == "compile_error_spike"]
+    assert spike and spike[0]["errors"] >= 5
+
+
+def test_spill_thrash_flag(ev):
+    ev._sample_counters(time.time() - 1.0)
+    tel.inc("spill_demotions", 40)
+    flags = ev.anomalies()
+    thrash = [f for f in flags if f["kind"] == "spill_thrash"]
+    assert thrash and thrash[0]["moves"] >= 40
+
+
+def test_engine_section_shape(ev):
+    sec = ev.engine_section()
+    assert sec["enabled"] is True
+    assert {r["class"] for r in sec["classes"]} == \
+        {"interactive", "batch", "background"}
+    assert isinstance(sec["anomalies"], list)
+    assert sec["bus"]["ring"] == ev.ring_len()
+
+
+# ---------------------------------------------------------------------------
+# system tables
+# ---------------------------------------------------------------------------
+
+def test_system_events_table_armed(ev, tmp_path, monkeypatch):
+    monkeypatch.setenv("DSQL_EVENTS_FILE", str(tmp_path / "e.jsonl"))
+    with ev.trace_id_scope("tbl-trace"):
+        ev.publish("table.test", detail_field=42)
+    from dask_sql_tpu.runtime import system_tables as st
+    t = st.build("events")
+    rows = t.to_pylist()
+    by = dict(zip(t.names, rows[-1]))
+    assert by["type"] == "table.test" and by["trace"] == "tbl-trace"
+    assert json.loads(by["detail"]) == {"detail_field": 42}
+
+
+def test_system_slo_table_armed(ev):
+    ev.get_monitor().observe("interactive", 1.0)
+    from dask_sql_tpu.runtime import system_tables as st
+    t = st.build("slo")
+    assert t.names[0] == "class" and "burn_fast" in t.names
+    rows = t.to_pylist()
+    assert len(rows) == 3
+
+
+def test_system_tables_empty_when_disarmed(monkeypatch):
+    monkeypatch.delenv("DSQL_EVENTS", raising=False)
+    from dask_sql_tpu.runtime import system_tables as st
+    for name in ("events", "slo"):
+        t = st.build(name)
+        assert t.num_rows == 0          # fixed schema, zero rows
+        assert t.num_columns > 0
+
+
+# ---------------------------------------------------------------------------
+# the zero-import disabled path
+# ---------------------------------------------------------------------------
+
+def test_disabled_query_never_imports_events():
+    """With DSQL_EVENTS unset an end-to-end query must leave
+    runtime.events out of sys.modules entirely — the tripwire that keeps
+    the watchtower's cost at one env read."""
+    code = (
+        "import sys\n"
+        "from dask_sql_tpu import Context\n"
+        "c = Context()\n"
+        "c.create_table('t', {'a': [1, 2, 3]})\n"
+        "assert c.sql('SELECT SUM(a) AS s FROM t').to_pylist() == [[6]]\n"
+        "assert 'dask_sql_tpu.runtime.events' not in sys.modules, \\\n"
+        "    'disabled path imported the watchtower'\n"
+    )
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("DSQL_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr.decode()

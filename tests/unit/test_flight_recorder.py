@@ -143,6 +143,51 @@ def test_plan_history_bytes_headroom(hist, monkeypatch):
     assert fr.plan_history_bytes(plan, c) == 1000  # clamped to >= 1.0
 
 
+def test_stats_sidecar_bounded_under_churn(hist, monkeypatch):
+    """The EWMA sidecar must not grow without bound as ad-hoc plans churn
+    unique fingerprints: ring truncation prunes entries past the TTL and
+    caps survivors to DSQL_HISTORY_STATS_MAX newest-by-updated."""
+    monkeypatch.setenv("DSQL_HISTORY_MB", "0.001")     # truncate often
+    monkeypatch.setenv("DSQL_HISTORY_STATS_MAX", "20")
+    cap = fr.stats_max_entries()
+    assert cap == 20
+    pad = "x" * 120
+    for i in range(200):                # 200 one-off fingerprints
+        fr._observe_stat(f"churn-fp-{i}", nbytes=1000 + i)
+        fr._append(hist, {"kind": "query", "i": i, "pad": pad})
+    stats = fr._STATS.read()
+    # bounded: prune rides truncation cadence, so between truncations at
+    # most one ring-half of fresh observations sits past the cap — far
+    # below the 200 fingerprints churned
+    per_cycle = fr.history_limit_bytes() // 2 // 120
+    assert len(stats) <= cap + per_cycle
+    fr._prune_stats()
+    assert len(fr._STATS.read()) <= cap
+    # newest-by-updated win
+    assert "churn-fp-199" in fr._STATS.read()
+    assert "churn-fp-0" not in stats
+
+
+def test_stats_sidecar_ttl_prune(hist, monkeypatch):
+    monkeypatch.setenv("DSQL_HISTORY_STATS_TTL_S", "60")
+    fr._observe_stat("fresh-fp", nbytes=100)
+    stale = dict(fr._STATS.read())
+    stale["stale-fp"] = {"bytes": 1.0, "n": 1,
+                         "updated": __import__("time").time() - 3600}
+    stale["no-timestamp-fp"] = {"bytes": 1.0, "n": 1}
+    fr._STATS.write(stale)
+    fr._prune_stats()
+    stats = fr._STATS.read()
+    assert "fresh-fp" in stats
+    assert "stale-fp" not in stats          # past the TTL
+    assert "no-timestamp-fp" not in stats   # no updated => prunable
+    # default TTL parses and floors sanely
+    monkeypatch.delenv("DSQL_HISTORY_STATS_TTL_S", raising=False)
+    assert fr.stats_ttl_s() == 7 * 86400.0
+    monkeypatch.setenv("DSQL_HISTORY_STATS_TTL_S", "junk")
+    assert fr.stats_ttl_s() == 7 * 86400.0
+
+
 # ---------------------------------------------------------------------------
 # recording through real queries
 # ---------------------------------------------------------------------------
