@@ -751,6 +751,49 @@ def _stage_main():
                 os.environ["DSQL_MAX_CONCURRENT_QUERIES"] = "0"
                 os.environ["DSQL_EVENTS"] = "0"
 
+        # PARAM-MIX pass (ISSUE 16): a Zipf-distributed client mix of one
+        # query SHAPE with many distinct literals — the dominant
+        # production pattern parameterized plan identity exists for.
+        # Journals compiles vs distinct literals (the sublinearity proof:
+        # one shape compiles once however many literals arrive) and the
+        # plan-cache hit rate the headline publishes.
+        if left() > 20:
+            os.environ["DSQL_RESULT_CACHE_MB"] = "0"
+            try:
+                import numpy as np
+
+                from dask_sql_tpu.runtime import telemetry as _tl
+                tpl = ("SELECT l_returnflag, SUM(l_extendedprice) AS s, "
+                       "COUNT(*) AS n FROM lineitem WHERE l_quantity > ? "
+                       "GROUP BY l_returnflag ORDER BY l_returnflag")
+                rng = np.random.RandomState(23)
+                distinct = [float(v) for v in
+                            np.linspace(1.0, 45.0, 12).round(2)]
+                # Zipf rank-frequency over the distinct literals: a few
+                # hot values, a long tail — rank r drawn w.p. ∝ 1/r^1.2
+                ranks = np.clip(rng.zipf(1.2, size=36), 1,
+                                len(distinct)) - 1
+                pm0 = _tl.REGISTRY.counters()
+                execs = 0
+                for r in ranks:
+                    c.sql(tpl, params=[distinct[int(r)]],
+                          return_futures=False)
+                    execs += 1
+                pm1 = _tl.REGISTRY.counters()
+                emit({"param_mix": {
+                    "distinct_literals": len(set(int(r) for r in ranks)),
+                    "executions": execs,
+                    "compiles": pm1["compiles"] - pm0["compiles"],
+                    "param_plans": (pm1["param_plans"]
+                                    - pm0["param_plans"]),
+                    "param_plan_hits": (pm1["param_plan_hits"]
+                                        - pm0["param_plan_hits"]),
+                    "param_plan_misses": (pm1["param_plan_misses"]
+                                          - pm0["param_plan_misses"]),
+                }})
+            except Exception as e:
+                emit({"param_mix_fail": True, "error": repr(e)[:200]})
+
         # ESTIMATE-ERROR journal: for every measured query, the byte error
         # of the scan-bytes heuristic vs the flight recorder's measured
         # history against the EWMA'd actual working set — the evidence that
@@ -918,6 +961,7 @@ def main():
         first_arrival, restart_times, restart_info = {}, {}, {}
         est_err, est_err_admitted, est_from_hist = {}, {}, None
         slo_att = None
+        param_mix = None
         shard_scaling = None
         ooc_evidence = None
         mv_evidence = None
@@ -978,6 +1022,8 @@ def main():
                         mv_evidence = rec["mv"] or None
                     elif "slo_attainment" in rec:
                         slo_att = rec["slo_attainment"] or None
+                    elif "param_mix" in rec:
+                        param_mix = rec["param_mix"] or None
                     elif "estimate_error" in rec:
                         est_err = rec["estimate_error"] or {}
                         est_err_admitted = \
@@ -1042,6 +1088,13 @@ def main():
             # concurrent-burst pass (the one scheduler-armed window);
             # None when the burst never ran
             "slo_attainment": slo_att,
+            # parameterized plan identity (ISSUE 16): fraction of the
+            # Zipf param-mix executions served by an already-compiled
+            # program of their shape; None when the mix never ran
+            "param_plan_hit_rate": (
+                round(param_mix["param_plan_hits"]
+                      / max(param_mix["executions"], 1), 3)
+                if param_mix else None),
         }
         if not done:
             out = {"metric": "tpch_q1_q22_geomean_wall", "value": -1,
@@ -1154,6 +1207,10 @@ def main():
                     # pass (2-slot scheduler, 8 mixed-priority threads):
                     # the fraction the admission controller turned away,
                     # and queue-time percentiles for the admitted rest
+                    # sublinearity proof (ISSUE 16): a Zipf client mix of
+                    # one query shape with many distinct literals — the
+                    # compile count must track SHAPES (1), not literals
+                    "compiles_vs_distinct_literals": param_mix,
                     "admission_reject_rate": (
                         round(sum(1 for b in bursts
                                   if b.get("outcome") == "rejected")

@@ -68,6 +68,10 @@ class Context:
         # (runtime/system_tables.py): created on first system.* resolution;
         # a user schema literally named "system" shadows it
         self._system_schema: Optional[SchemaContainer] = None
+        # PREPARE registry: name -> PrepareStatement (parsed AST + text).
+        # EXECUTE binds the stored AST with fresh values; system.prepared
+        # lists entries (physical/rel/custom.py, runtime/system_tables.py)
+        self._prepared: dict = {}
         # register default input plugins (reference context.py:113-119 order)
         for plugin in (DeviceTableInputPlugin(), PandasLikeInputPlugin(),
                        DictInputPlugin(), ArrowInputPlugin(), HiveInputPlugin(),
@@ -357,7 +361,8 @@ class Context:
             dataframes: Optional[dict] = None, gpu: bool = False,
             config_options: Optional[dict] = None,
             timeout: Optional[float] = None,
-            priority: Optional[str] = None) -> Union[Table, Any]:
+            priority: Optional[str] = None,
+            params: Optional[list] = None) -> Union[Table, Any]:
         """Parse, plan, optimize and execute a SQL statement.
 
         Returns a device ``Table`` (``return_futures=True``, the analogue of
@@ -384,6 +389,11 @@ class Context:
         (or ``interactive``); the server maps its ``X-DSQL-Priority``
         header here.  Time spent queued counts against ``timeout`` and
         shows up as the ``queued`` phase of the QueryReport.
+
+        ``params`` binds positional ``?`` / ``$n`` markers in the statement
+        to python values (client-side prepared statements).  Combined with
+        parameterized plan identity (plan/parameterize.py) every distinct
+        value list reuses one compiled program per query shape.
         """
         from .runtime import (resilience as _res, scheduler as _sched,
                               telemetry as _tel)
@@ -410,7 +420,8 @@ class Context:
                 self.last_timings = timings
                 result = None
                 for stmt in stmts:
-                    result = self._execute_statement(stmt, sql)
+                    result = self._execute_statement(stmt, sql,
+                                                     params=params)
                 if result is None:
                     result = Table([], [])
                 if trace is not None and isinstance(result, Table):
@@ -441,7 +452,8 @@ class Context:
                         if v is not None:
                             timings[f"{k}_ms"] = v
 
-    def _execute_statement(self, stmt: A.Statement, sql: str):
+    def _execute_statement(self, stmt: A.Statement, sql: str,
+                           params: Optional[list] = None):
         from .physical.rel.custom import StatementDispatcher
         from .runtime import telemetry as _tel
 
@@ -450,7 +462,7 @@ class Context:
         if isinstance(stmt, A.QueryStatement):
             t0 = _time.perf_counter()
             with _tel.span("plan"):
-                plan = self._get_plan(stmt.query, sql)
+                plan = self._get_plan(stmt.query, sql, params=params)
             if timings is not None:
                 timings["plan_ms"] += (_time.perf_counter() - t0) * 1e3
                 t0 = _time.perf_counter()
@@ -552,8 +564,9 @@ class Context:
             _tel.annotate(result_cache="store")
         return result
 
-    def _get_plan(self, query: A.SelectLike, sql: str = "") -> RelNode:
-        binder = Binder(self, sql)
+    def _get_plan(self, query: A.SelectLike, sql: str = "",
+                  params: Optional[list] = None) -> RelNode:
+        binder = Binder(self, sql, params=params)
         plan = binder.bind(query)
         # context threads through so the stats-driven join-order pass
         # (plan/optimizer.py reorder_joins_stats) can rank join orders by

@@ -63,7 +63,7 @@ from ..ops.kernels import (canon_f64, comparable_data, float_class,
 from ..plan.nodes import (
     LogicalAggregate, LogicalFilter, LogicalJoin, LogicalProject, LogicalSort,
     LogicalTableScan, LogicalUnion, LogicalValues, LogicalWindow, RelNode,
-    RexCall, RexInputRef, RexLiteral, RexNode,
+    RexCall, RexInputRef, RexLiteral, RexNode, RexParam,
 )
 from ..runtime import (faults as _faults, kvstore as _kv,
                        program_store as _pstore, quarantine as _quar,
@@ -159,9 +159,24 @@ class Unsupported(Exception):
 # fingerprinting
 # ---------------------------------------------------------------------------
 
-def _fp_rex(rex: RexNode, context=None, scans=None) -> str:
+def _fp_rex(rex: RexNode, context=None, scans=None, params=None) -> str:
+    if params is None:
+        params = []
     if isinstance(rex, RexInputRef):
         return f"@{rex.index}"
+    if isinstance(rex, RexParam):
+        # hoisted literal (plan/parameterize.py): identity is POSITION and
+        # type, never the value — every literal variant of a shape shares
+        # this fingerprint, and the value rides as a trailing jit argument.
+        # The position is the node's index in THIS serialization walk, so
+        # the ``params`` list accumulated alongside the text IS the
+        # bound-argument order; any caller that serializes the same
+        # (sub)plan recovers the same numbering.
+        for i, p in enumerate(params):
+            if p is rex:
+                return f"P{i}:{rex.stype.name}"
+        params.append(rex)
+        return f"P{len(params) - 1}:{rex.stype.name}"
     if isinstance(rex, RexLiteral):
         return f"L{rex.stype.name}:{rex.value!r}"
     if isinstance(rex, RexCall):
@@ -172,20 +187,24 @@ def _fp_rex(rex: RexNode, context=None, scans=None) -> str:
         if info is not None:
             extra = f"!{getattr(info, 'name', info)}"
         return (f"C{rex.op}{extra}["
-                + ",".join(_fp_rex(o, context, scans) for o in rex.operands)
+                + ",".join(_fp_rex(o, context, scans, params)
+                           for o in rex.operands)
                 + f"]:{rex.stype.name}")
     from ..plan.nodes import RexScalarSubquery
     if isinstance(rex, RexScalarSubquery) and context is not None:
         # uncorrelated scalar subquery: the subplan joins the cache key and
         # its scans join the input spec; the tracer inlines it as a
         # broadcast 1-row result
-        return ("S[" + _fp_plan(rex.plan, context, scans)
+        return ("S[" + _fp_plan(rex.plan, context, scans, params)
                 + f"]:{rex.stype.name}")
     raise Unsupported(type(rex).__name__)
 
 
-def _fp_plan(rel: RelNode, context, scans: list) -> str:
-    """Serialize the plan for cache keying; collects scan tables."""
+def _fp_plan(rel: RelNode, context, scans: list, params=None) -> str:
+    """Serialize the plan for cache keying; collects scan tables (and the
+    plan's RexParam nodes, in serialization order, into ``params``)."""
+    if params is None:
+        params = []
     t = type(rel).__name__
     schema = ";".join(f"{f.name}:{f.stype.name}" for f in rel.schema)
     if isinstance(rel, LogicalTableScan):
@@ -199,9 +218,10 @@ def _fp_plan(rel: RelNode, context, scans: list) -> str:
         rv = "+rv" if entry.row_valid is not None else ""
         return f"Scan({rel.schema_name}.{rel.table_name}{rv})[{schema}]"
     if isinstance(rel, LogicalProject):
-        body = ",".join(_fp_rex(e, context, scans) for e in rel.exprs)
+        body = ",".join(_fp_rex(e, context, scans, params)
+                        for e in rel.exprs)
     elif isinstance(rel, LogicalFilter):
-        body = _fp_rex(rel.condition, context, scans)
+        body = _fp_rex(rel.condition, context, scans, params)
     elif isinstance(rel, LogicalAggregate):
         for agg in rel.aggs:
             if agg.udaf is not None:
@@ -225,7 +245,8 @@ def _fp_plan(rel: RelNode, context, scans: list) -> str:
         # null-aware anti (NOT IN) compiles too; the flag joins the
         # fingerprint so it can't share a program with a plain anti join
         na = "N" if getattr(rel, "null_aware", False) else ""
-        cond = ("T" if rel.condition is None else _fp_rex(rel.condition, context, scans))
+        cond = ("T" if rel.condition is None
+                else _fp_rex(rel.condition, context, scans, params))
         body = f"{rel.join_type}{na}|{cond}"
     elif isinstance(rel, LogicalSort):
         body = (",".join(f"{c.index}{'a' if c.ascending else 'd'}"
@@ -249,7 +270,7 @@ def _fp_plan(rel: RelNode, context, scans: list) -> str:
         body = repr([[lit.value for lit in row] for row in rel.rows])
     else:
         raise Unsupported(type(rel).__name__)
-    kids = ",".join(_fp_plan(i, context, scans) for i in rel.inputs)
+    kids = ",".join(_fp_plan(i, context, scans, params) for i in rel.inputs)
     return f"{t}({body})[{schema}]<{kids}>"
 
 
@@ -910,6 +931,11 @@ class _Tracer:
         # filter nodes (by id) eligible for learned-capacity compaction —
         # computed by _compact_eligible over the whole plan before tracing
         self.compact_ok: set = set()
+        # id(RexParam) -> traced 0-d scalar for the plan's hoisted literals
+        # (set by _build's fn from the trailing jit arguments); None on
+        # unparameterized programs — evaluate._eval_param then reads the
+        # node's carried value, which only happens outside a param trace
+        self.param_values: Optional[Dict[int, jax.Array]] = None
 
     def traced_scalar_subquery(self, rex, outer_table: Table) -> Column:
         """Inline an uncorrelated scalar subquery into this trace.
@@ -2176,8 +2202,37 @@ def _flatten_tables(scans) -> List[jax.Array]:
     return flat
 
 
+def _param_args(params) -> List[jax.Array]:
+    """Bound-argument vector for a parameterized plan: one dtype-stable 0-d
+    device scalar per hoisted literal, in FINGERPRINT order (``params`` is
+    the list ``_fp_plan`` accumulated while serializing the plan — the
+    ``P{i}`` positions in the key and these argument positions can never
+    disagree).  The dtype comes from the declared SQL type, not the python
+    value, so ``x > 5`` and ``x > 5000000000`` with the same declared type
+    share a program while different declared types never do."""
+    from ..types import physical_dtype
+    return [jnp.asarray(p.value, dtype=physical_dtype(p.stype))
+            for p in params]
+
+
+def _maybe_parameterize(plan: RelNode, count: bool = True):
+    """Hoist literals into runtime arguments (plan/parameterize.py) unless
+    the DSQL_PARAM_PLANS kill switch is off.  Idempotent — re-entries from
+    the degradation ladder / background compiles hoist nothing and count
+    nothing; probes pass ``count=False`` so a tier prediction never
+    inflates the execution counters."""
+    from ..plan.parameterize import param_plans_enabled, parameterize_plan
+    if not param_plans_enabled():
+        return plan
+    new, hoisted = parameterize_plan(plan)
+    if hoisted and count:
+        _tel.inc("param_plans")
+        _tel.inc("param_literals_hoisted", hoisted)
+    return new
+
+
 def _build(plan: RelNode, context, scans, caps: Dict[str, int], key,
-           origin=None):
+           origin=None, params=None):
     """Create the jitted program for this plan + input spec."""
     spec = []
     for skey, tbl, row_valid in scans:
@@ -2202,6 +2257,13 @@ def _build(plan: RelNode, context, scans, caps: Dict[str, int], key,
             tables[skey] = (Table(names, cols), valid)
         from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
         tr = _Tracer(context, tables, caps)
+        if params:
+            # trailing args are the hoisted-literal scalars, in the same
+            # order _fp_plan collected them; the rex evaluator resolves
+            # each RexParam node to ITS traced scalar by node identity
+            base = len(flat) - len(params)
+            tr.param_values = {id(p): flat[base + j]
+                               for j, p in enumerate(params)}
         if _on_tpu() and os.environ.get("DSQL_COMPACT", "1") != "0":
             # TPU only: off-TPU the hash kernels already cost O(valid rows)
             # and gathers/scatters are ~1 ms — compaction buys nothing there
@@ -3066,6 +3128,9 @@ def tier_probe(plan: RelNode, context) -> str:
         return "eager"
     from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
 
+    # the probe must key exactly as try_execute_compiled will: literals
+    # hoist into params BEFORE fingerprinting (shape identity)
+    plan = _maybe_parameterize(plan, count=False)
     scans: list = []
     try:
         plan_fp = _fp_plan(plan, context, scans)
@@ -3104,6 +3169,13 @@ def try_execute_compiled(plan: RelNode, context,
     _res.check("compile_entry")
     from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
 
+    # parameterized plan identity: eligible literals hoist into runtime
+    # arguments here, at the single entry of the compiled pipeline, so
+    # every fingerprint below (whole-plan, stage subplans, program-store
+    # digests, EWMA keys) sees the SHAPE while the values ride as trailing
+    # jit args.  The eager/SPMD/result-cache paths never see this plan —
+    # they key on values, which stays correct.
+    plan = _maybe_parameterize(plan)
     scans: list = []
     try:
         plan_fp = _fp_plan(plan, context, scans)
@@ -3152,8 +3224,9 @@ def _execute_single(plan: RelNode, context, query_fp: str,
     from ..ops.pallas_kernels import _strategy_on_tpu as _on_tpu
 
     scans: list = []
+    params: list = []
     try:
-        plan_fp = _fp_plan(plan, context, scans)
+        plan_fp = _fp_plan(plan, context, scans, params)
     except Unsupported as e:
         logger.debug("not compilable: %s", e)
         _tel.inc("unsupported")
@@ -3173,8 +3246,9 @@ def _execute_single(plan: RelNode, context, query_fp: str,
         host_sort = plan
         plan = plan.input
         scans = []
+        params = []
         try:
-            plan_fp = _fp_plan(plan, context, scans)
+            plan_fp = _fp_plan(plan, context, scans, params)
         except Unsupported as e:
             logger.debug("not compilable: %s", e)
             _tel.inc("unsupported")
@@ -3245,6 +3319,11 @@ def _execute_single(plan: RelNode, context, query_fp: str,
             _tel.inc("unsupported")
             return None
         flat = _flatten_tables(scans)
+        if params:
+            # bound-argument vector: the hoisted literals, after the table
+            # arrays — arity and treedef stay consistent everywhere flat
+            # flows (jit call, AOT lower, store n_args, store replay)
+            flat = flat + _param_args(params)
         outs = None
         if entry is None and not store_tried and _pstore.get_store().enabled():
             # persistent program store: a prior process compiled this exact
@@ -3257,6 +3336,11 @@ def _execute_single(plan: RelNode, context, query_fp: str,
                 got = _pstore_attempt(base_key, flat, query_fp)
             if got is not None:
                 loaded, outs, caps = got
+                if params:
+                    # a stored program served this literal variant with
+                    # zero compiles — the cross-process half of the
+                    # one-program-per-shape guarantee
+                    _tel.inc("param_plan_hits")
                 if my_event is not None:
                     # release the in-flight claim taken under the caps we
                     # guessed before the load told us the real ones
@@ -3309,7 +3393,8 @@ def _execute_single(plan: RelNode, context, query_fp: str,
                                     qkey, label=plan_fp[:60]):
                                 _faults.maybe_fail("compile")
                                 entry = _build(plan, context, scans, caps,
-                                               key, origin=query_fp)
+                                               key, origin=query_fp,
+                                               params=params)
                                 if _pstore.get_store().enabled() \
                                         or _profile_on():
                                     # AOT lower+compile: same trace, same
@@ -3370,6 +3455,8 @@ def _execute_single(plan: RelNode, context, query_fp: str,
                 if degrade is None:
                     _tel.inc("compiles")
                     _note_compile_result(True)
+                    if params:
+                        _tel.inc("param_plan_misses")
                     if in_stage:
                         _tel.inc("stage_compiles")
                     if qstore.enabled():
@@ -3415,6 +3502,8 @@ def _execute_single(plan: RelNode, context, query_fp: str,
         elif outs is None:  # in-memory hit (a store load already ran once)
             _tel.inc("hits")
             _tel.annotate(cache_hit=True)
+            if params:
+                _tel.inc("param_plan_hits")
             if in_stage:
                 _tel.inc("stage_hits")
             if entry.origin is not None and entry.origin != query_fp:

@@ -529,6 +529,43 @@ def _explain_analyze(plan, context):
     return _meta_table({"PLAN": np.array(lines, dtype=object)})
 
 
+# ---------------------------------------------------------------------------
+# PREPARE / EXECUTE / DEALLOCATE (server-side prepared statements; pairs
+# with parameterized plan identity — plan/parameterize.py — so every
+# EXECUTE of one prepared shape reuses a single compiled program)
+# ---------------------------------------------------------------------------
+
+def _prepare(stmt: A.PrepareStatement, context, sql):
+    context._prepared[stmt.name.lower()] = stmt
+    return None
+
+
+def _execute_prepared(stmt: A.ExecuteStatement, context, sql):
+    from ...runtime import telemetry as _tel
+
+    prep = context._prepared.get(stmt.name.lower())
+    if prep is None:
+        raise RuntimeError(
+            f"Prepared statement {stmt.name!r} does not exist.")
+    if len(stmt.params) < prep.num_params:
+        raise RuntimeError(
+            f"Prepared statement {stmt.name!r} requires {prep.num_params} "
+            f"parameters, {len(stmt.params)} given.")
+    plan = context._get_plan(prep.query, sql, params=stmt.params)
+    _tel.inc("prepared_executes")
+    return context._execute_query_plan(plan)
+
+
+def _deallocate(stmt: A.DeallocateStatement, context, sql):
+    if stmt.name is None:
+        context._prepared.clear()
+        return None
+    if context._prepared.pop(stmt.name.lower(), None) is None:
+        raise RuntimeError(
+            f"Prepared statement {stmt.name!r} does not exist.")
+    return None
+
+
 StatementDispatcher.add_plugin("CreateSchema", _create_schema)
 StatementDispatcher.add_plugin("DropSchema", _drop_schema)
 StatementDispatcher.add_plugin("UseSchema", _use_schema)
@@ -551,3 +588,6 @@ StatementDispatcher.add_plugin("CreateModel", _create_model)
 StatementDispatcher.add_plugin("CreateExperiment", _create_experiment)
 StatementDispatcher.add_plugin("ExportModel", _export_model)
 StatementDispatcher.add_plugin("ExplainStatement", _explain)
+StatementDispatcher.add_plugin("PrepareStatement", _prepare)
+StatementDispatcher.add_plugin("ExecuteStatement", _execute_prepared)
+StatementDispatcher.add_plugin("DeallocateStatement", _deallocate)

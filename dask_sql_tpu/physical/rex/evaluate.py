@@ -12,7 +12,8 @@ from typing import Union
 import numpy as np
 
 from ...plan.nodes import (
-    RexCall, RexInputRef, RexLiteral, RexNode, RexScalarSubquery, RexUdf,
+    RexCall, RexInputRef, RexLiteral, RexNode, RexParam, RexScalarSubquery,
+    RexUdf,
 )
 from ...table import Column, Scalar, Table
 from ...utils import Pluggable
@@ -34,6 +35,21 @@ def _eval_input_ref(rex: RexInputRef, table: Table, executor):
 
 
 def _eval_literal(rex: RexLiteral, table: Table, executor):
+    return Scalar(rex.value, rex.stype)
+
+
+def _eval_param(rex: RexParam, table: Table, executor):
+    """Hoisted literal (plan/parameterize.py).  Inside a compiled trace the
+    value is the TRACED scalar the program received as a trailing jit
+    argument (``compiled._build`` maps each param node to its arg); every
+    other executor — eager, SPMD, stats probes — reads the node's carried
+    value exactly like a literal, which is correct because those paths key
+    their caches on values."""
+    vals = getattr(executor, "param_values", None)
+    if vals is not None:
+        v = vals.get(id(rex))
+        if v is not None:
+            return Scalar(v, rex.stype)
     return Scalar(rex.value, rex.stype)
 
 
@@ -98,6 +114,7 @@ def _eval_udf(rex: RexUdf, table: Table, executor):
 
 RexExecutor.add_plugin("RexInputRef", _eval_input_ref)
 RexExecutor.add_plugin("RexLiteral", _eval_literal)
+RexExecutor.add_plugin("RexParam", _eval_param)
 RexExecutor.add_plugin("RexCall", _eval_call)
 RexExecutor.add_plugin("RexScalarSubquery", _eval_scalar_subquery)
 RexExecutor.add_plugin("RexUdf", _eval_udf)

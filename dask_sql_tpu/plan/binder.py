@@ -199,9 +199,14 @@ class Binder:
     """Binds one statement. ``catalog`` is a Context-like object exposing
     resolve_table(parts) and get_function(name)."""
 
-    def __init__(self, catalog, sql: str = "", outer_scope: Optional[Scope] = None):
+    def __init__(self, catalog, sql: str = "", outer_scope: Optional[Scope] = None,
+                 params: Optional[list] = None):
         self.catalog = catalog
         self.sql = sql
+        # positional parameter values for ?/$n markers (Context.sql(params=...)
+        # and EXECUTE); None means "no parameters were supplied" — a marker
+        # then stays a binding error exactly as before
+        self.params = params
         self.cte_stack: List[Dict[str, RelNode]] = [{}]
         # enclosing query's scope for correlated subqueries: unresolved
         # columns become RexOuterRef and are eliminated by decorrelation
@@ -484,7 +489,8 @@ class Binder:
         gets it from Calcite's SubQueryRemoveRule). Empty groups vanish from
         the grouped aggregate, which matches NULL-compares-false semantics
         for a WHERE conjunct."""
-        sub = Binder(self.catalog, self.sql, outer_scope=scope)
+        sub = Binder(self.catalog, self.sql, outer_scope=scope,
+                             params=self.params)
         sub.cte_stack = self.cte_stack[:]
         sub_plan = sub.bind_query(sq.query)
         if len(sub_plan.schema) != 1:
@@ -632,7 +638,8 @@ class Binder:
         scalar subquery's empty-result semantics."""
         for e, _alias in proj_items:
             for sq in _walk_scalar_subqueries(e):
-                sub = Binder(self.catalog, self.sql, outer_scope=scope)
+                sub = Binder(self.catalog, self.sql, outer_scope=scope,
+                             params=self.params)
                 sub.cte_stack = self.cte_stack[:]
                 sub_plan = sub.bind_query(sq.query)
                 if not _plan_has_outer(sub_plan):
@@ -687,7 +694,8 @@ class Binder:
         kind = inner.kind
         neg = negated != inner.negated
         if kind == "exists":
-            sub = Binder(self.catalog, self.sql, outer_scope=scope)
+            sub = Binder(self.catalog, self.sql, outer_scope=scope,
+                             params=self.params)
             sub.cte_stack = self.cte_stack[:]
             sub_plan = sub.bind_query(inner.query)
             jt = "ANTI" if neg else "SEMI"
@@ -705,7 +713,7 @@ class Binder:
                               schema=list(plan.schema))
             return True, out
         if kind in ("in", "any", "all"):
-            sub = Binder(self.catalog, self.sql)
+            sub = Binder(self.catalog, self.sql, params=self.params)
             sub.cte_stack = self.cte_stack[:]
             sub_plan = sub.bind_query(inner.query)
             if len(sub_plan.schema) != 1:
@@ -1104,7 +1112,8 @@ class Binder:
                 # bind with the outer scope visible so a correlated subquery
                 # in an unsupported position fails with a clear message, not
                 # a phantom "column not found"
-                sub = Binder(self.catalog, self.sql, outer_scope=scope)
+                sub = Binder(self.catalog, self.sql, outer_scope=scope,
+                             params=self.params)
                 sub.cte_stack = self.cte_stack[:]
                 sub_plan = sub.bind_query(e.query)
                 if _plan_has_outer(sub_plan):
@@ -1116,7 +1125,8 @@ class Binder:
                 t = sub_plan.schema[0].stype.with_nullable(True)
                 return RexScalarSubquery(sub_plan, t)
             if e.kind == "exists":
-                sub = Binder(self.catalog, self.sql, outer_scope=scope)
+                sub = Binder(self.catalog, self.sql, outer_scope=scope,
+                             params=self.params)
                 sub.cte_stack = self.cte_stack[:]
                 sub_plan = sub.bind_query(e.query)
                 if _plan_has_outer(sub_plan):
@@ -1137,8 +1147,43 @@ class Binder:
             # not expressible -> only supported at top-level WHERE conjuncts
             self.error("IN/ANY subquery only supported in WHERE conjuncts", e)
         if isinstance(e, A.Param):
-            self.error("Positional parameters not supported", e)
+            if self.params is None:
+                self.error("Positional parameters not supported without "
+                           "bound values (pass params=[...] or use EXECUTE)", e)
+            if not (0 <= e.index < len(self.params)):
+                self.error(f"Parameter ${e.index + 1} has no bound value "
+                           f"({len(self.params)} supplied)", e)
+            return self._bind_param_value(self.params[e.index], e)
         self.error(f"Unsupported expression {type(e).__name__}", e)
+
+    def _bind_param_value(self, v, node) -> RexLiteral:
+        """A bound parameter value becomes an inline literal with the same
+        python-type inference ``_bind_literal`` applies to parsed literals;
+        the parameterization pass (plan/parameterize.py) then re-hoists
+        eligible ones, so distinct values still share one compiled shape."""
+        import datetime
+
+        if v is None:
+            return RexLiteral(None, NULLTYPE)
+        if isinstance(v, bool):          # before int: bool is an int subclass
+            return RexLiteral(v, SqlType("BOOLEAN", nullable=False))
+        if isinstance(v, int):
+            t = INTEGER if -(2**31) <= v < 2**31 else BIGINT
+            return RexLiteral(v, t.with_nullable(False))
+        if isinstance(v, float):
+            return RexLiteral(v, SqlType("DOUBLE", nullable=False))
+        if isinstance(v, str):
+            return RexLiteral(v, SqlType("VARCHAR", nullable=False))
+        if isinstance(v, datetime.datetime):
+            return RexLiteral(python_value_to_physical(v, TIMESTAMP),
+                              SqlType("TIMESTAMP", nullable=False))
+        if isinstance(v, datetime.date):
+            return RexLiteral(python_value_to_physical(v, DATE),
+                              SqlType("DATE", nullable=False))
+        if isinstance(v, datetime.time):
+            return RexLiteral(python_value_to_physical(v, TIME),
+                              SqlType("TIME", nullable=False))
+        self.error(f"Unsupported parameter type {type(v).__name__}", node)
 
     def _bind_literal(self, e: A.Literal) -> RexLiteral:
         tn = e.type_name

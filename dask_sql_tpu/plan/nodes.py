@@ -49,6 +49,31 @@ class RexLiteral(RexNode):
 
 
 @dataclass
+class RexParam(RexNode):
+    """A literal hoisted into a runtime argument (plan/parameterize.py).
+
+    Deliberately NOT a ``RexLiteral`` subclass: every site that bakes a
+    literal's VALUE into a compiled trace or a shape-level fingerprint
+    dispatches on ``isinstance(rex, RexLiteral)``, and a param must never
+    take those branches — unknown rex kinds fail safe everywhere
+    (``compiled._fp_rex`` raises Unsupported, ``result_cache._canon_rex``
+    marks the plan volatile) until a site opts in explicitly.
+
+    The node carries its CURRENT value, so any (sub)plan containing params
+    can self-supply its bound-argument vector: the compiled path collects
+    params in fingerprint-traversal order and passes the values as trailing
+    scalar jit arguments, while the eager/SPMD paths (which key on values)
+    simply read ``value`` like a literal.  ``slot`` is the hoisting pass's
+    deterministic numbering over the whole plan — stable per shape."""
+    slot: int
+    value: Any
+    stype: SqlType
+
+    def __repr__(self):
+        return f"?p{self.slot}={self.value!r}"
+
+
+@dataclass
 class RexCall(RexNode):
     op: str                 # canonical operator name, e.g. "+", "AND", "SUBSTRING"
     operands: List[RexNode]

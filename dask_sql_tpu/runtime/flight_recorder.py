@@ -275,10 +275,20 @@ def plan_fingerprint(plan, context) -> Optional[str]:
     result_cache.canonical_plan TEXT only — no epochs, no uids — so the
     same query shape keys the same history entry across restarts and table
     reloads.  None for volatile plans (their measurements would mix
-    unrelated executions)."""
-    from . import result_cache as _rc
+    unrelated executions).
 
-    text, volatile, _scans = _rc.canonical_plan(plan, context)
+    The plan is parameterized first (plan/parameterize.py) and serialized
+    in SHAPE mode, so every literal variant of a query shape shares one
+    EWMA history entry: cost/working-set estimates learned from
+    ``x > 10`` inform admission of ``x > 20``.  With DSQL_PARAM_PLANS=0
+    the pass is the identity and fingerprints match the pre-param era
+    bit-for-bit."""
+    from . import result_cache as _rc
+    from ..plan.parameterize import param_plans_enabled, parameterize_plan
+
+    if param_plans_enabled():
+        plan, _ = parameterize_plan(plan)
+    text, volatile, _scans = _rc.canonical_plan(plan, context, shape=True)
     if volatile:
         return None
     return digest_key(text)

@@ -85,22 +85,35 @@ def _env_mb(name: str, default: float) -> float:
 # ---------------------------------------------------------------------------
 
 class _Canon:
-    """Accumulator for one canonicalization walk."""
+    """Accumulator for one canonicalization walk.
 
-    __slots__ = ("parts", "scans", "volatile")
+    ``shape=True`` serializes hoisted parameters (RexParam) by slot and
+    type only — the SHAPE identity the flight recorder's EWMA history
+    keys on, so cost estimates transfer across literal variants.  The
+    default stays value-bearing: result-cache keys, stage boundary names
+    and SPMD digests must distinguish literals, or two variants of a
+    shape would replay each other's ANSWERS."""
 
-    def __init__(self):
+    __slots__ = ("parts", "scans", "volatile", "shape")
+
+    def __init__(self, shape: bool = False):
         self.parts: List[str] = []
         self.scans: List[Tuple[str, str]] = []
         self.volatile = False
+        self.shape = shape
 
 
 def _canon_rex(rex, acc: _Canon) -> None:
     from ..plan.nodes import (RexCall, RexInputRef, RexLiteral, RexOuterRef,
-                              RexScalarSubquery, RexUdf)
+                              RexParam, RexScalarSubquery, RexUdf)
 
     if isinstance(rex, RexInputRef):
         acc.parts.append(f"${rex.index}")
+    elif isinstance(rex, RexParam):
+        if acc.shape:
+            acc.parts.append(f"P{rex.slot}:{rex.stype.name}")
+        else:
+            acc.parts.append(f"P{rex.slot}:{rex.stype.name}={rex.value!r}")
     elif isinstance(rex, RexLiteral):
         acc.parts.append(f"L{rex.stype.name}:{rex.value!r}")
     elif isinstance(rex, RexCall):
@@ -214,10 +227,13 @@ def _canon_rel(rel, acc: _Canon) -> None:
     acc.parts.append(">")
 
 
-def canonical_plan(rel, context=None) -> Tuple[str, bool,
-                                               List[Tuple[str, str]]]:
-    """(canonical text, volatile, referenced (schema, table) pairs)."""
-    acc = _Canon()
+def canonical_plan(rel, context=None, shape: bool = False) -> Tuple[
+        str, bool, List[Tuple[str, str]]]:
+    """(canonical text, volatile, referenced (schema, table) pairs).
+
+    ``shape=True`` collapses hoisted literals (RexParam) to slot+type so
+    the text names the query SHAPE — see ``_Canon``."""
+    acc = _Canon(shape=shape)
     _canon_rel(rel, acc)
     return "".join(acc.parts), acc.volatile, acc.scans
 

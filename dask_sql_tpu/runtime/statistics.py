@@ -291,7 +291,10 @@ _MIN_SEL = 5e-4
 def _literal_value(rex):
     from ..plan import nodes as N
 
-    if isinstance(rex, N.RexLiteral):
+    # RexParam carries its current literal value — selectivity estimates
+    # use it exactly like an inline literal (estimates are advisory; only
+    # program identity must be value-free)
+    if isinstance(rex, (N.RexLiteral, N.RexParam)):
         v = rex.value
         if isinstance(v, bool):
             return float(v)

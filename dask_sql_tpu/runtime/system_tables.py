@@ -32,7 +32,7 @@ from ..table import Table
 
 TABLE_NAMES = ("queries", "active", "metrics", "cache", "quarantine",
                "programs", "table_stats", "mesh", "spill", "devices",
-               "matviews", "view_candidates", "events", "slo")
+               "matviews", "view_candidates", "events", "slo", "prepared")
 
 
 def _col(rows: List[dict], key: str, dtype, default):
@@ -324,6 +324,21 @@ def _spill() -> Table:
     })
 
 
+def _prepared(context=None) -> Table:
+    """One row per PREPARE-registered statement on the resolving context
+    (physical/rel/custom.py): name, parameter count, and the statement
+    text EXECUTE will bind."""
+    reg = getattr(context, "_prepared", None) or {}
+    rows = [{"name": name, "num_params": int(stmt.num_params),
+             "statement": stmt.sql}
+            for name, stmt in sorted(reg.items())]
+    return Table.from_pydict({
+        "name": _col(rows, "name", object, ""),
+        "num_params": _col(rows, "num_params", np.int64, 0),
+        "statement": _col(rows, "statement", object, ""),
+    })
+
+
 def _matviews(context=None) -> Table:
     """One row per registered materialized view (runtime/matview.py):
     maintainability verdict with the full-recompute reason, delta backlog,
@@ -429,10 +444,12 @@ _BUILDERS: Dict[str, object] = {
     "view_candidates": _view_candidates,
     "events": _events,
     "slo": _slo,
+    "prepared": _prepared,
 }
 
 #: builders that need the resolving context (catalog / mesh live there)
-_CONTEXT_BUILDERS = (_table_stats, _mesh, _matviews, _view_candidates)
+_CONTEXT_BUILDERS = (_table_stats, _mesh, _matviews, _view_candidates,
+                     _prepared)
 
 
 def build(name: str, context=None) -> Optional[Table]:

@@ -157,6 +157,15 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     # that failed and were dropped (never the caller's problem), and
     # edge-triggered multi-window SLO burn-rate breaches
     "events_published", "events_dropped", "slo_breaches",
+    # parameterized plan identity (plan/parameterize.py, ISSUE 16):
+    # plans that had ≥1 literal hoisted, total literals hoisted, and
+    # compiled-path program lookups for parameterized plans that hit
+    # (in-memory cache or program store) vs compiled fresh;
+    # prepared_executes counts EXECUTE statements served from the
+    # per-context PREPARE registry
+    "param_plans", "param_literals_hoisted",
+    "param_plan_hits", "param_plan_misses",
+    "prepared_executes",
 )
 
 STABLE_HISTOGRAMS: Tuple[str, ...] = (

@@ -203,7 +203,8 @@ class _QueryInfo:
 def _run_tracked(context, sql: str, info: _QueryInfo,
                  cancel: Optional[threading.Event] = None,
                  seat: Optional[_sched.Seat] = None,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 params: Optional[list] = None):
     from ..physical import compiled
     from contextlib import nullcontext
 
@@ -233,7 +234,7 @@ def _run_tracked(context, sql: str, info: _QueryInfo,
         # workload manager, which consumes its timestamp + priority.
         with tid_scope, _sched.seat_scope(seat), \
                 _res.query_scope(cancel=cancel):
-            table = context.sql(sql)
+            table = context.sql(sql, params=params)
     finally:
         info.cpu_sec = time.thread_time() - cpu0
         info.finished = time.monotonic()
@@ -748,6 +749,31 @@ def _make_handler(state: _AppState, base_url: str):
             sql = self.rfile.read(length).decode()
             _tel.inc("server_queries")
             uid = str(uuid_mod.uuid4())
+            # JSON envelope with server-side parameters: a
+            # ``Content-Type: application/json`` body of
+            # ``{"sql": "...", "params": [...]}`` binds positional ?/$n
+            # markers (Context.sql(params=...)); a plain body stays the
+            # raw SQL text it always was
+            params = None
+            ctype = (self.headers.get("Content-Type") or "")
+            if ctype.split(";")[0].strip().lower() == "application/json":
+                try:
+                    payload = json.loads(sql)
+                    sql = payload["sql"]
+                    params = payload.get("params")
+                except (ValueError, TypeError, KeyError):
+                    _tel.inc("server_query_errors")
+                    self._send(400, _error_payload(
+                        'Invalid JSON statement body (expected '
+                        '{"sql": "...", "params": [...]})', uid),
+                        headers=self._trace_headers())
+                    return
+                if params is not None and not isinstance(params, list):
+                    _tel.inc("server_query_errors")
+                    self._send(400, _error_payload(
+                        '"params" must be a JSON array', uid),
+                        headers=self._trace_headers())
+                    return
             mgr = _sched.get_manager()
             # watchtower ingress: honor the client's X-DSQL-Trace or mint
             # one HERE, before any verdict, so success AND every
@@ -801,7 +827,7 @@ def _make_handler(state: _AppState, base_url: str):
             if seat is not None:
                 state.seats[uid] = seat
             fut = state.pool.submit(_run_tracked, state.context, sql, info,
-                                    cancel, seat, tid)
+                                    cancel, seat, tid, params)
             state.future_list[uid] = fut
             self._send(200, {
                 "id": uid, "infoUri": base_url,

@@ -63,7 +63,8 @@ class Star(Expr):
 
 @dataclass
 class Param(Expr):
-    """Positional parameter ``?`` (used by synthesized queries)."""
+    """Positional parameter: ``?`` (indexed left-to-right in statement
+    order) or ``$n`` (1-based explicit index, stored 0-based)."""
     index: int = 0
     pos: Tuple[int, int] = (0, 0)
 
@@ -271,6 +272,33 @@ class Statement(Node):
 @dataclass
 class QueryStatement(Statement):
     query: SelectLike = None
+
+
+@dataclass
+class PrepareStatement(Statement):
+    """PREPARE name AS <query> — the query text is stored verbatim (and
+    the parsed AST alongside) in the per-context registry; binding is
+    deferred to EXECUTE so each execution binds fresh parameter values."""
+    name: str = ""
+    query: SelectLike = None
+    sql: str = ""                 # original statement text (for system.prepared)
+    num_params: int = 0
+    pos: Tuple[int, int] = (0, 0)
+
+
+@dataclass
+class ExecuteStatement(Statement):
+    """EXECUTE name [(expr, ...)] — args must be literals (possibly signed)."""
+    name: str = ""
+    params: List[Any] = field(default_factory=list)   # python values
+    pos: Tuple[int, int] = (0, 0)
+
+
+@dataclass
+class DeallocateStatement(Statement):
+    """DEALLOCATE [PREPARE] name | ALL"""
+    name: Optional[str] = None    # None == ALL
+    pos: Tuple[int, int] = (0, 0)
 
 
 @dataclass

@@ -35,7 +35,7 @@ class Token:
 
 
 _MULTI_OPS = ["<>", "!=", ">=", "<=", "||", "::", "=>"]
-_SINGLE_OPS = set("+-*/%=<>(),.;[]{}?&^|~:")
+_SINGLE_OPS = set("+-*/%=<>(),.;[]{}?&^|~:$")
 
 
 def tokenize(sql: str) -> List[Token]:

@@ -22,6 +22,7 @@ from .ast import (
     CreateMaterializedView, CreateModel, CreateSchema, CreateTable,
     CreateTableAs, DescribeModel, DescribeTable, DropMaterializedView,
     DropModel, DropSchema, DropTable, ExplainStatement, ExportModel, Expr,
+    DeallocateStatement, ExecuteStatement, PrepareStatement,
     InList, InsertInto, IntervalLiteral, IsBool, IsDistinctFrom, IsNull,
     JoinRelation, Like, Literal, Param, PredictRelation, QueryStatement,
     RefreshMaterializedView, Relation, Select, SelectLike, SetOp, ShowColumns,
@@ -56,6 +57,15 @@ class Parser:
         except LexError as e:
             raise ParsingException(sql, str(e), e.line, e.col) from None
         self.i = 0
+        # positional-parameter bookkeeping: ``?`` markers number
+        # left-to-right in token order; ``$n`` names an explicit 1-based
+        # slot.  num_params() reports how many values a statement needs.
+        self._param_seq = 0
+        self._param_max = 0
+
+    def num_params(self) -> int:
+        """Parameter slots referenced by everything parsed so far."""
+        return max(self._param_seq, self._param_max)
 
     # ------------------------------------------------------------------ utils
     @property
@@ -163,6 +173,12 @@ class Parser:
                 return self._parse_insert()
             if u == "REFRESH":
                 return self._parse_refresh()
+            if u == "PREPARE":
+                return self._parse_prepare()
+            if u == "EXECUTE":
+                return self._parse_execute()
+            if u == "DEALLOCATE":
+                return self._parse_deallocate()
             if u == "EXPLAIN":
                 self.i += 1
                 analyze = bool(self.eat_kw("ANALYZE"))
@@ -174,6 +190,63 @@ class Parser:
         if t.kind == "IDENT" and t.upper in ("SELECT", "WITH", "VALUES") or self.at_op("("):
             return QueryStatement(query=self.parse_query())
         self.error("Expected a SQL statement")
+
+    # -- PREPARE / EXECUTE / DEALLOCATE ------------------------------------
+    def _parse_prepare(self) -> Statement:
+        pos = (self.cur.line, self.cur.col)
+        self.expect_kw("PREPARE")
+        name = self.identifier("prepared statement name")
+        self.expect_kw("AS")
+        before = self.num_params()
+        query = self._parse_parenthesized_or_plain_query()
+        return PrepareStatement(name=name, query=query, sql=self.sql,
+                                num_params=self.num_params() - before,
+                                pos=pos)
+
+    def _parse_execute(self) -> Statement:
+        pos = (self.cur.line, self.cur.col)
+        self.expect_kw("EXECUTE")
+        name = self.identifier("prepared statement name")
+        params: List = []
+        if self.eat_op("("):
+            if not self.at_op(")"):
+                params.append(self._parse_param_value())
+                while self.eat_op(","):
+                    params.append(self._parse_param_value())
+            self.expect_op(")")
+        return ExecuteStatement(name=name, params=params, pos=pos)
+
+    def _parse_deallocate(self) -> Statement:
+        pos = (self.cur.line, self.cur.col)
+        self.expect_kw("DEALLOCATE")
+        self.eat_kw("PREPARE")
+        if self.eat_kw("ALL"):
+            return DeallocateStatement(name=None, pos=pos)
+        return DeallocateStatement(
+            name=self.identifier("prepared statement name"), pos=pos)
+
+    def _parse_param_value(self):
+        """EXECUTE argument: a (possibly signed) literal python value."""
+        t = self.cur
+        sign = 1
+        while self.at_op("-", "+"):
+            if self.cur.text == "-":
+                sign = -sign
+            self.i += 1
+            t = self.cur
+        if t.kind == "NUMBER":
+            self.i += 1
+            return sign * _number_value(t.text)
+        if t.kind == "STRING":
+            self.i += 1
+            return t.text
+        if self.eat_kw("TRUE"):
+            return True
+        if self.eat_kw("FALSE"):
+            return False
+        if self.eat_kw("NULL"):
+            return None
+        self.error("Expected a literal EXECUTE argument")
 
     # -- CREATE ------------------------------------------------------------
     def _parse_create(self) -> Statement:
@@ -904,7 +977,19 @@ class Parser:
             return Literal(value=t.text, type_name="VARCHAR", pos=pos)
         if self.at_op("?"):
             self.i += 1
-            return Param(pos=pos)
+            idx = self._param_seq
+            self._param_seq += 1
+            return Param(index=idx, pos=pos)
+        if self.at_op("$"):
+            self.i += 1
+            if self.cur.kind != "NUMBER" or not self.cur.text.isdigit():
+                self.error("Expected a parameter number after '$'")
+            n = int(self.cur.text)
+            if n < 1:
+                self.error("Parameter numbers are 1-based")
+            self.i += 1
+            self._param_max = max(self._param_max, n)
+            return Param(index=n - 1, pos=pos)
         if self.at_op("("):
             self.i += 1
             if self.at_kw("SELECT", "WITH", "VALUES"):

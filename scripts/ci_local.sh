@@ -113,6 +113,13 @@ echo "=== [2n] events smoke (watchtower: traces, bus, SLO burn) ==="
 # burn-rate gauge, and DSQL_EVENTS=0 must never even import the bus
 python scripts/events_smoke.py
 
+echo "=== [2o] param smoke (parameterized plan identity) ==="
+# 50 literal variants of one query shape must compile at most twice with
+# a >90% plan-cache hit rate and pandas-oracle parity; a fresh process
+# must serve a never-seen literal of a stored shape with zero compiles;
+# DSQL_PARAM_PLANS=0 must restore value-baked program identity
+python scripts/param_smoke.py
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \

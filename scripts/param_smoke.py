@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Parameterized-plan smoke gate: one compiled program per query shape.
+
+Run by scripts/ci_local.sh (mirroring warmstart_smoke.py):
+
+    python scripts/param_smoke.py
+
+Asserts, in one process:
+
+  1. 50 literal variants of ONE query shape compile at most twice
+     (``compiles <= 2`` — one for the shape; headroom for a capacity
+     escalation) with a plan-cache hit rate above 90%
+     (``param_plan_hits / executions``);
+  2. every variant matches the pandas oracle — hoisted literals must not
+     change answers;
+  3. ``DSQL_PARAM_PLANS=0`` restores value-baked program identity: the
+     same variants each compile their own program and no ``param_*``
+     counter moves — the kill switch is bit-for-bit;
+  4. across a REAL process boundary: a fresh interpreter pointed at the
+     populated ``DSQL_PROGRAM_STORE`` answers a NEVER-SEEN literal of the
+     same shape with zero XLA compiles.
+
+Exit 0 on success — if shape identity silently rots (fingerprints start
+baking values again, the store stops serving cross-literal), this gate
+fails loudly.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("DSQL_RESULT_CACHE_MB", "0")
+os.environ.setdefault("DSQL_MAX_CONCURRENT_QUERIES", "0")
+os.environ.setdefault("DSQL_TIERED", "0")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+N = 60_000
+VARIANTS = 50
+
+QUERY = "SELECT k, SUM(v) AS s, COUNT(*) AS n FROM t WHERE v > {lit} GROUP BY k ORDER BY k"
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _frame():
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.RandomState(11)
+    return pd.DataFrame({"k": rng.randint(0, 16, N), "v": rng.rand(N)})
+
+
+def _oracle(frame, lit):
+    sub = frame[frame.v > lit]
+    return (sub.groupby("k").agg(s=("v", "sum"), n=("v", "size"))
+            .reset_index().sort_values("k", ignore_index=True))
+
+
+def _literals():
+    return [round(0.01 + 0.018 * i, 4) for i in range(VARIANTS)]
+
+
+def _run_variants(c, frame):
+    import pandas as pd
+
+    from dask_sql_tpu.runtime import telemetry as tel
+
+    c0 = tel.REGISTRY.counters()
+    for lit in _literals():
+        got = (c.sql(QUERY.format(lit=lit), return_futures=False)
+               .sort_values("k", ignore_index=True))
+        exp = _oracle(frame, lit)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False,
+                                      atol=1e-6, rtol=1e-6)
+    now = tel.REGISTRY.counters()
+    return {k: now[k] - c0.get(k, 0) for k in now}
+
+
+def _phase_main(phase: str) -> int:
+    """Child body: run one literal of the shape, print counters."""
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.runtime import telemetry as tel
+
+    lit = float(os.environ["PARAM_SMOKE_LIT"])
+    c = Context()
+    c.create_table("t", _frame())
+    out = (c.sql(QUERY.format(lit=lit), return_futures=False)
+           .sort_values("k", ignore_index=True))
+    snap = tel.REGISTRY.counters()
+    print("PARAMSMOKE_JSON " + json.dumps({
+        "result": {"k": [int(x) for x in out["k"]],
+                   "s": [round(float(x), 6) for x in out["s"]],
+                   "n": [int(x) for x in out["n"]]},
+        "compiles": snap["compiles"],
+        "stores": snap["program_store_stores"],
+        "hits": snap["program_store_hits"],
+        "param_plan_hits": snap["param_plan_hits"],
+    }))
+    return 0
+
+
+def _run_phase(lit: float, env: dict) -> dict:
+    env = dict(env, PARAM_SMOKE_LIT=str(lit))
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--phase=child"],
+        capture_output=True, text=True, env=env, timeout=420)
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-3000:])
+        raise RuntimeError(f"child exited rc={r.returncode}")
+    for line in r.stdout.splitlines():
+        if line.startswith("PARAMSMOKE_JSON "):
+            return json.loads(line[len("PARAMSMOKE_JSON "):])
+    sys.stderr.write(r.stdout[-2000:] + r.stderr[-2000:])
+    raise RuntimeError("child emitted no result line")
+
+
+def main() -> int:
+    from dask_sql_tpu import Context
+
+    frame = _frame()
+
+    print(f"== {VARIANTS} literal variants, param plans ON ==")
+    os.environ.pop("DSQL_PARAM_PLANS", None)
+    c = Context()
+    c.create_table("t", frame)
+    t0 = time.perf_counter()
+    d = _run_variants(c, frame)
+    hit_rate = d["param_plan_hits"] / float(VARIANTS)
+    print(f"on: compiles={d['compiles']} param_plan_hits="
+          f"{d['param_plan_hits']} hit_rate={hit_rate:.2%} "
+          f"({time.perf_counter() - t0:.1f}s)")
+    if d["compiles"] > 2:
+        return fail(f"{VARIANTS} variants of one shape paid "
+                    f"{d['compiles']} compiles (want <= 2)")
+    if hit_rate <= 0.90:
+        return fail(f"plan-cache hit rate {hit_rate:.2%} (want > 90%)")
+    if d["param_plans"] < VARIANTS:
+        return fail(f"only {d['param_plans']}/{VARIANTS} plans were "
+                    "parameterized")
+
+    print("== kill switch (DSQL_PARAM_PLANS=0) ==")
+    os.environ["DSQL_PARAM_PLANS"] = "0"
+    try:
+        c2 = Context()
+        c2.create_table("t", frame)
+        t0 = time.perf_counter()
+        d0 = _run_variants(c2, frame)
+        print(f"off: compiles={d0['compiles']} "
+              f"({time.perf_counter() - t0:.1f}s)")
+        if d0["compiles"] != VARIANTS:
+            return fail(f"kill switch: expected {VARIANTS} value-baked "
+                        f"compiles, got {d0['compiles']}")
+        moved = {k: v for k, v in d0.items()
+                 if k.startswith("param_") and v}
+        if moved:
+            return fail(f"kill switch: param counters moved: {moved}")
+    finally:
+        os.environ.pop("DSQL_PARAM_PLANS", None)
+
+    print("== fresh process, never-seen literal, populated store ==")
+    store_dir = tempfile.mkdtemp(prefix="param_smoke_store_")
+    base_env = dict(os.environ,
+                    JAX_PLATFORMS="cpu",
+                    DSQL_PROGRAM_STORE=store_dir,
+                    DSQL_RESULT_CACHE_MB="0",
+                    DSQL_MAX_CONCURRENT_QUERIES="0",
+                    DSQL_TIERED="0")
+    base_env.pop("DSQL_FAULT_INJECT", None)
+    populate = _run_phase(0.25, base_env)
+    warm = _run_phase(0.75, base_env)   # DIFFERENT literal
+    print(f"populate: compiles={populate['compiles']} "
+          f"stores={populate['stores']}; "
+          f"warm: compiles={warm['compiles']} hits={warm['hits']}")
+    if populate["compiles"] < 1 or populate["stores"] < 1:
+        return fail("populate process did not persist its program")
+    if warm["compiles"] != 0:
+        return fail(f"fresh process paid {warm['compiles']} compiles for a "
+                    "new literal of a stored shape")
+    if warm["hits"] < 1 or warm["param_plan_hits"] < 1:
+        return fail("fresh process did not hit the store for the shape")
+    # the stored program must be fed the NEW literal, not replay the old
+    # one: the warm answer must equal the warm-literal pandas oracle
+    for lit, got in ((0.25, populate["result"]), (0.75, warm["result"])):
+        exp = _oracle(frame, lit)
+        ok = (got["k"] == [int(x) for x in exp["k"]]
+              and got["n"] == [int(x) for x in exp["n"]]
+              and all(abs(a - float(b)) < 1e-4
+                      for a, b in zip(got["s"], exp["s"])))
+        if not ok:
+            return fail(f"literal {lit}: fresh-process answer does not "
+                        "match the pandas oracle (baked literal?)")
+    if populate["result"] == warm["result"]:
+        return fail("different literals returned identical results")
+
+    print("param smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    if "--phase=child" in sys.argv[1:]:
+        sys.exit(_phase_main("child"))
+    sys.exit(main())

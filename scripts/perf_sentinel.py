@@ -44,7 +44,8 @@ HEADLINE_SCHEMA = 1
 # direction of "better" per headline metric; anything not listed is
 # reported but never judged
 LOWER_BETTER = ("warm_exec_geomean_sec", "first_arrival_sec")
-HIGHER_BETTER = ("program_store_hit_rate", "vs_pandas_geomean")
+HIGHER_BETTER = ("program_store_hit_rate", "vs_pandas_geomean",
+                 "param_plan_hit_rate")
 NO_INCREASE = ("compile_errors",)
 # headline fields shown as context but NEVER gated on: the watchtower's
 # per-class SLO attainment depends on the burst pass's load shape, so a
@@ -119,7 +120,7 @@ def extract_headline(doc: dict) -> Optional[Dict[str, object]]:
     cs = det.get("compiled_stats") or {}
     ce = cs.get("compile_errors") if isinstance(cs, dict) else None
     out["compile_errors"] = int(ce) if ce is not None else None
-    if all(out[k] is None for k in
+    if all(out.get(k) is None for k in
            LOWER_BETTER + HIGHER_BETTER + NO_INCREASE):
         return None
     return out

@@ -495,8 +495,10 @@ def test_learned_split_hint(monkeypatch, tmp_path):
     assert staged == []
 
     # write the hint for this exact plan shape, as the failure path would
+    # (which fingerprints the PARAMETERIZED plan — literals hoisted)
     from dask_sql_tpu.sql.parser import parse_sql
-    plan = c._get_plan(parse_sql(QUERIES[3])[0].query)
+    plan = cm._maybe_parameterize(
+        c._get_plan(parse_sql(QUERIES[3])[0].query), count=False)
     from dask_sql_tpu.ops.pallas_kernels import _strategy_on_tpu
     scans = []
     key = (cm._fp_plan(plan, c, scans), cm._fp_inputs(scans),
