@@ -34,6 +34,12 @@ from .utils import ParsingException
 logger = logging.getLogger(__name__)
 
 
+def _tenancy_on() -> bool:
+    # tenancy gate (runtime/tenancy.py): env checked BEFORE any import so
+    # DSQL_TENANCY=0 keeps the module out of the process entirely
+    return os.environ.get("DSQL_TENANCY", "1").strip() not in ("", "0")
+
+
 class Context:
     """Main entry point: holds schemas/tables/functions/models and runs SQL.
 
@@ -362,7 +368,8 @@ class Context:
             config_options: Optional[dict] = None,
             timeout: Optional[float] = None,
             priority: Optional[str] = None,
-            params: Optional[list] = None) -> Union[Table, Any]:
+            params: Optional[list] = None,
+            tenant: Optional[str] = None) -> Union[Table, Any]:
         """Parse, plan, optimize and execute a SQL statement.
 
         Returns a device ``Table`` (``return_futures=True``, the analogue of
@@ -394,9 +401,25 @@ class Context:
         to python values (client-side prepared statements).  Combined with
         parameterized plan identity (plan/parameterize.py) every distinct
         value list reuses one compiled program per query shape.
+
+        ``tenant`` names the tenant this query bills against
+        (runtime/tenancy.py; the server maps its ``X-DSQL-Tenant`` header
+        here): per-tenant token-bucket rate (``DSQL_TENANT_QPS``) and
+        concurrency (``DSQL_TENANT_CONCURRENT``) quotas plus a per-tenant
+        circuit breaker (``DSQL_TENANT_BREAKER``) are enforced at
+        admission, raising typed ``TenantQuotaExceeded`` /
+        ``TenantCircuitOpen`` (429 + Retry-After on the server wire).
+        Unset = the ``default`` tenant; all quotas default to unlimited,
+        and ``DSQL_TENANCY=0`` disables the subsystem entirely.
         """
         from .runtime import (resilience as _res, scheduler as _sched,
                               telemetry as _tel)
+
+        from contextlib import nullcontext
+        ten_scope = nullcontext()
+        if tenant is not None and _tenancy_on():
+            from .runtime import tenancy as _ten
+            ten_scope = _ten.tenant_scope(tenant)
 
         if dataframes is not None:
             for df_name, df in dataframes.items():
@@ -411,7 +434,7 @@ class Context:
         try:
             with _res.query_scope(timeout_s=timeout), \
                     _tel.trace_scope(sql) as trace, \
-                    _sched.priority_scope(priority):
+                    _sched.priority_scope(priority), ten_scope:
                 t0 = _time.perf_counter()
                 with _tel.span("parse"):
                     stmts = parse_sql(sql)
@@ -483,9 +506,18 @@ class Context:
         # manager first: bounded admission, priority pick, working-set
         # reservation.  Disabled (DSQL_MAX_CONCURRENT_QUERIES=0) or nested
         # plans pass straight through (admission yields None).
+        # Tenancy admission wraps OUTSIDE the scheduler's: a tenant over
+        # quota must be rejected before it consumes a slot or queue
+        # position (env-gated before import; a server pre-claim is
+        # adopted here instead of re-claimed).
+        from contextlib import nullcontext
         from .runtime import scheduler as _sched
 
-        with _sched.get_manager().admission(plan, self):
+        ten_adm = nullcontext()
+        if _tenancy_on():
+            from .runtime import tenancy as _ten
+            ten_adm = _ten.admission()
+        with ten_adm, _sched.get_manager().admission(plan, self):
             return self._run_query_plan(plan)
 
     def _run_query_plan(self, plan):

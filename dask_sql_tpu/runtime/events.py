@@ -452,6 +452,23 @@ class SloMonitor:
         with self._lock:
             return [c for c in SLO_CLASSES if self._breached[c]]
 
+    def burning_classes(self) -> List[str]:
+        """Classes whose fast AND slow burns exceed the threshold RIGHT
+        NOW, recomputed from the sample windows.  The ``_breached`` flags
+        only update when a query of that class completes — a class that
+        stops completing queries would stay flagged forever — so the
+        load shedder (runtime/scheduler.py) must use this live view: as
+        the breaching samples age out of the windows the burns fall and
+        shedding lifts on its own."""
+        now = time.time()
+        thresh = burn_threshold()
+        out = []
+        for cls in SLO_CLASSES:
+            burn_f, burn_s = self._burns(cls, now)
+            if burn_f > thresh and burn_s > thresh:
+                out.append(cls)
+        return out
+
     def rows(self) -> List[dict]:
         """One row per class for ``system.slo`` / the engine section."""
         now = time.time()
@@ -586,11 +603,39 @@ def on_trace_open(trace) -> None:
     publish("query.begin", trace=tid, query=trace.query.strip()[:200])
 
 
+#: per-tenant [total, within-objective] completion counts — feeds the
+#: ``slo_attainment_tenant_<name>`` gauges (ISSUE 17: per-tenant SLO
+#: attainment); tenant names are pre-sanitized to the trace-ID charset
+#: (runtime/tenancy.py), so they are safe as gauge-name suffixes
+_tenant_slo: Dict[str, List[int]] = {}
+_tenant_slo_lock = threading.Lock()
+
+
+def observe_tenant(tenant: str, priority: Optional[str],
+                   wall_ms: float) -> None:
+    """Fold one completed query into the tenant's SLO attainment gauge,
+    judged against the query's own class objective."""
+    cls = SloMonitor._class(priority)
+    ok = float(wall_ms) <= objective_ms(cls)
+    with _tenant_slo_lock:
+        tot = _tenant_slo.setdefault(str(tenant), [0, 0])
+        tot[0] += 1
+        if ok:
+            tot[1] += 1
+        total, good = tot
+    _tel.REGISTRY.set_gauge(f"slo_attainment_tenant_{tenant}",
+                            round(good / total, 6))
+
+
 def on_query_complete(report, error: Optional[BaseException]) -> None:
     """Fold one completed query into the SLO monitor and publish
     ``query.done``; called from ``telemetry._close_trace`` after the
     ``DSQL_EVENTS`` gate."""
     get_monitor().observe(getattr(report, "priority", None), report.wall_ms)
+    tenant = getattr(report, "tenant", None)
+    if tenant:
+        observe_tenant(tenant, getattr(report, "priority", None),
+                       report.wall_ms)
     publish("query.done",
             trace=getattr(report, "trace_id", None),
             outcome="error" if error is not None else "ok",
@@ -598,6 +643,7 @@ def on_query_complete(report, error: Optional[BaseException]) -> None:
             wall_ms=round(report.wall_ms, 3),
             tier=getattr(report, "tier", None),
             priority=getattr(report, "priority", None),
+            tenant=tenant,
             cache_hit=bool((getattr(report, "cache", None) or {})
                            .get("hit")),
             rows_out=int(getattr(report, "rows_out", 0)))
@@ -612,3 +658,5 @@ def _reset_for_tests() -> None:
         _MONITOR = None
     with _counter_lock:
         _counter_ring.clear()
+    with _tenant_slo_lock:
+        _tenant_slo.clear()

@@ -382,6 +382,12 @@ def record_query(report, error: Optional[BaseException] = None) -> None:
     tid = getattr(report, "trace_id", None)
     if tid:
         rec["trace"] = str(tid)
+    # tenant identity (runtime/tenancy.py): same conditional-field
+    # discipline — only an explicitly-tenanted query carries it, so
+    # default-tenant envelopes stay byte-identical
+    ten = getattr(report, "tenant", None)
+    if ten:
+        rec["tenant"] = str(ten)
     _append(path, rec)
     if plan_fp and error is None and measured > 0:
         _observe_stat(plan_fp, nbytes=measured, rows=report.rows_out,

@@ -158,6 +158,35 @@ class ServerDraining(AdmissionRejected):
     error_name = "SERVER_SHUTTING_DOWN"
 
 
+class TenantQuotaExceeded(AdmissionRejected):
+    """The tenant's token-bucket rate (``DSQL_TENANT_QPS``) or concurrency
+    quota (``DSQL_TENANT_CONCURRENT``) is exhausted (runtime/tenancy.py).
+    Rides the AdmissionRejected wire path: HTTP 429 + ``Retry-After``
+    derived from the bucket's refill time."""
+
+    error_name = "TENANT_QUOTA_EXCEEDED"
+
+
+class TenantCircuitOpen(AdmissionRejected):
+    """The tenant's circuit breaker is open (``DSQL_TENANT_BREAKER``
+    consecutive fatal/timeout verdicts): admissions are refused
+    immediately until a half-open probe succeeds — the tenant's failure
+    loop must not keep burning engine slots.  HTTP 429 + ``Retry-After``
+    set to the remaining open window."""
+
+    error_name = "TENANT_CIRCUIT_OPEN"
+
+
+class LoadShedRejected(AdmissionRejected):
+    """Burn-driven load shed (runtime/scheduler.py): a priority class is
+    burning its SLO error budget past ``DSQL_SLO_BURN`` on BOTH burn
+    windows, so background-class admissions are refused before the SLO
+    actually breaches.  HTTP 429 + ``Retry-After``; clears on its own
+    when the burn recovers."""
+
+    error_name = "SLO_LOAD_SHED"
+
+
 # exception type NAMES (not imports: the parser/binder layer must stay
 # importable without this module) that are user mistakes by construction
 _USER_ERROR_NAMES = frozenset({

@@ -86,8 +86,8 @@ from typing import Dict, List, Optional, Tuple
 
 from . import faults as _faults, telemetry as _tel
 from . import resilience as _res
-from .resilience import (AdmissionRejected, AdmissionTimeout, ServerDraining,
-                         _env_int)
+from .resilience import (AdmissionRejected, AdmissionTimeout,
+                         LoadShedRejected, ServerDraining, _env_int)
 
 logger = logging.getLogger(__name__)
 
@@ -96,6 +96,16 @@ def _events_on() -> bool:
     # watchtower gate: env checked BEFORE importing events.py, so the
     # bus stays un-imported (zero cost) when disarmed
     return os.environ.get("DSQL_EVENTS", "0").strip() not in ("", "0")
+
+
+def _shed_on() -> bool:
+    # burn-driven load shedding (needs the SLO monitor, so it is inert
+    # unless the watchtower is also armed); DSQL_SLO_SHED=0 disables
+    return os.environ.get("DSQL_SLO_SHED", "1").strip() not in ("", "0")
+
+
+_SHED_RETRY_AFTER_S = 5.0   # shed lifts as breaching samples age out of
+                            # the fast window; 5 s is a sane re-poll pace
 
 
 PRIORITIES = ("interactive", "batch", "background")
@@ -452,6 +462,7 @@ class WorkloadManager:
         self._deficit: Dict[str, float] = {p: 0.0 for p in PRIORITIES}
         self._run_ewma_s: Optional[float] = None
         self._drain = threading.Event()
+        self._shedding = False          # edge-trigger state for slo.shed
         self.ledger = MemoryLedger(cache_fn)
 
     # -- drain (SIGTERM/SIGINT graceful shutdown) ---------------------------
@@ -540,6 +551,47 @@ class WorkloadManager:
                      "estBytes": int(t.est_bytes)}
                     for p in PRIORITIES for t in self._waiting[p]]
 
+    # -- burn-driven load shedding (ISSUE 17) -------------------------------
+    def _check_shed(self, priority: str) -> None:
+        """Reject a background-class admission with the typed
+        :class:`resilience.LoadShedRejected` while any SLO class is
+        burning its error budget past ``DSQL_SLO_BURN`` on BOTH windows
+        (the live recompute — events.SloMonitor.burning_classes — so the
+        shed lifts by itself as breaching samples age out).  Shedding the
+        lowest class *before* the protected classes breach is the whole
+        point: deficit weights divide slots fairly, but fairness is the
+        wrong policy once the error budget is on fire.  Interactive and
+        batch admissions are never shed."""
+        if priority != "background" or not _shed_on() or not _events_on():
+            return
+        from . import events as _ev
+        try:
+            burning = _ev.get_monitor().burning_classes()
+        except Exception:       # never let the shed probe fail admission
+            logger.debug("shed probe failed", exc_info=True)
+            return
+        shedding = bool(burning)
+        fire = False
+        with self._cv:
+            if shedding != self._shedding:
+                self._shedding = shedding
+                fire = True
+        _tel.REGISTRY.set_gauge("slo_shedding", 1 if shedding else 0)
+        if fire:
+            _ev.publish("slo.shed", active=shedding,
+                        burning=sorted(burning))
+        if not shedding:
+            return
+        _tel.inc("sched_shed_background")
+        # ALSO counts into the rejected family: admitted + rejected +
+        # timeout == submitted must keep holding (chaos_soak invariant)
+        _tel.inc("sched_rejected_background")
+        raise LoadShedRejected(
+            f"background admissions shed: class(es) "
+            f"{', '.join(sorted(burning))} burning SLO error budget past "
+            f"{_ev.burn_threshold():g}x on both windows",
+            retry_after_s=_SHED_RETRY_AFTER_S)
+
     # -- seats (server POST-time pre-claims) --------------------------------
     def claim_seat(self, priority: str) -> Optional[Seat]:
         """Claim a place in line at submit time; raises AdmissionRejected
@@ -551,6 +603,7 @@ class WorkloadManager:
         if not self.enabled():
             return None
         priority = normalize_priority(priority)
+        self._check_shed(priority)
         with self._cv:
             limit, depth = self.limit(), self.depth()
             outstanding = (self._running + self._waiting_count_locked()
@@ -594,6 +647,11 @@ class WorkloadManager:
         if self.draining():
             _tel.inc(f"sched_rejected_{priority}")
             raise self._drain_verdict()
+        if seat is None:
+            # server-submitted queries were already shed-checked at seat
+            # claim time; checking their pre-claimed seat again here would
+            # double-count the reject counters for one submission
+            self._check_shed(priority)
         enqueued_at = seat.enqueued_at if seat is not None else \
             time.monotonic()
         ticket = Ticket(priority, int(est_bytes), enqueued_at)

@@ -32,7 +32,8 @@ from ..table import Table
 
 TABLE_NAMES = ("queries", "active", "metrics", "cache", "quarantine",
                "programs", "table_stats", "mesh", "spill", "devices",
-               "matviews", "view_candidates", "events", "slo", "prepared")
+               "matviews", "view_candidates", "events", "slo", "prepared",
+               "tenants")
 
 
 def _col(rows: List[dict], key: str, dtype, default):
@@ -63,6 +64,7 @@ def _queries() -> Table:
         "tier": _col(rows, "tier", object, ""),
         "priority": _col(rows, "priority", object, ""),
         "cache_hit": _col(rows, "cache_hit", np.bool_, False),
+        "tenant": _col(rows, "tenant", object, ""),
         "rows_out": _col(rows, "rows_out", np.int64, 0),
         "bytes_out": _col(rows, "bytes_out", np.int64, 0),
         "measured_bytes": _col(rows, "measured_bytes", np.int64, 0),
@@ -429,6 +431,35 @@ def _slo() -> Table:
     })
 
 
+def _tenants() -> Table:
+    """Per-tenant admission accounting and circuit state
+    (runtime/tenancy.py TenantRegistry).  Same env-gate-before-import
+    discipline as ``system.events`` — ``DSQL_TENANCY=0`` yields the fixed
+    empty schema and the module stays un-imported."""
+    import os
+
+    rows: List[dict] = []
+    if os.environ.get("DSQL_TENANCY", "1").strip() not in ("", "0"):
+        from . import tenancy as _ten
+
+        rows = _ten.tenant_rows()
+    return Table.from_pydict({
+        "tenant": _col(rows, "tenant", object, ""),
+        "inflight": _col(rows, "inflight", np.int64, 0),
+        "tokens": _col(rows, "tokens", np.float64, 0.0),
+        "submitted": _col(rows, "submitted", np.int64, 0),
+        "admitted": _col(rows, "admitted", np.int64, 0),
+        "completed": _col(rows, "completed", np.int64, 0),
+        "failed": _col(rows, "failed", np.int64, 0),
+        "quota_rejects": _col(rows, "quota_rejects", np.int64, 0),
+        "circuit_rejects": _col(rows, "circuit_rejects", np.int64, 0),
+        "circuit_opens": _col(rows, "circuit_opens", np.int64, 0),
+        "consecutive_failures": _col(rows, "consecutive_failures",
+                                     np.int64, 0),
+        "circuit": _col(rows, "circuit", object, ""),
+    })
+
+
 _BUILDERS: Dict[str, object] = {
     "queries": _queries,
     "active": _active,
@@ -445,6 +476,7 @@ _BUILDERS: Dict[str, object] = {
     "events": _events,
     "slo": _slo,
     "prepared": _prepared,
+    "tenants": _tenants,
 }
 
 #: builders that need the resolving context (catalog / mesh live there)

@@ -166,6 +166,25 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     "param_plans", "param_literals_hoisted",
     "param_plan_hits", "param_plan_misses",
     "prepared_executes",
+    # result spooler (server/app.py, ISSUE 17): results larger than
+    # DSQL_RESULT_PAGE_ROWS spool into the spill store and stream out
+    # through nextUri pages; the reaper GCs abandoned results/futures
+    # after DSQL_RESULT_TTL_S; fault_result_spool is the injection site
+    # (a fired spool fault degrades to the unpaged response, never loses
+    # the result)
+    "result_spooled", "result_pages_spooled", "result_pages_served",
+    "result_reaped", "fault_result_spool",
+    # multi-tenancy (runtime/tenancy.py): admissions claimed under a
+    # tenant, token-bucket/concurrency quota rejects, circuit-breaker
+    # rejects/opens and half-open probes
+    "tenant_queries", "tenant_quota_rejects", "tenant_circuit_rejects",
+    "tenant_circuit_opens", "tenant_circuit_probes",
+    # burn-driven load shedding (runtime/scheduler.py): background-class
+    # admissions refused while a class burns its SLO error budget past
+    # DSQL_SLO_BURN on both windows (each shed ALSO counts into
+    # sched_rejected_background, so the admission reconciliation
+    # invariant admitted + rejected + timeout == submitted still holds)
+    "sched_shed_background",
 )
 
 STABLE_HISTOGRAMS: Tuple[str, ...] = (
@@ -199,6 +218,12 @@ STABLE_GAUGES: Tuple[str, ...] = (
     "slo_burn_fast_background",
     "slo_burn_slow_interactive", "slo_burn_slow_batch",
     "slo_burn_slow_background",
+    # result spooler: live spooled pages + bytes awaiting collection
+    "result_spool_pages", "result_spool_bytes",
+    # 1 while burn-driven background shedding is active, else 0
+    "slo_shedding",
+    # tenants the registry has seen this process (runtime/tenancy.py)
+    "tenants_known",
 )
 
 # exponential-ish bucket bounds in milliseconds; histograms are BOUNDED by
@@ -554,7 +579,7 @@ class QueryReport:
     __slots__ = ("query", "wall_ms", "phases", "counters", "root",
                  "rows_out", "bytes_out", "started_unix", "cache", "tier",
                  "priority", "operators", "spilled", "skew_ratio",
-                 "collective_bytes", "cost_err", "trace_id")
+                 "collective_bytes", "cost_err", "trace_id", "tenant")
 
     def __init__(self, trace: QueryTrace):
         root = trace.root
@@ -567,6 +592,11 @@ class QueryReport:
         # watchtower is off — consumers emit it only when present
         tid = root.attrs.get("trace_id")
         self.trace_id = str(tid) if tid else None
+        # tenant identity (runtime/tenancy.py stamps it on the root when
+        # an explicit tenant was supplied); None otherwise — consumers
+        # emit it only when present, like the trace ID
+        ten = root.attrs.get("tenant")
+        self.tenant = str(ten) if ten else None
         self.rows_out = int(root.attrs.get("rows_out", 0))
         self.bytes_out = int(root.attrs.get("bytes_out", 0))
         phases: Dict[str, float] = {}
@@ -674,6 +704,7 @@ class QueryReport:
     def to_dict(self) -> dict:
         return {"query": self.query, "wall_ms": round(self.wall_ms, 3),
                 "trace_id": self.trace_id,
+                "tenant": self.tenant,
                 "phases": {k: round(v, 3) for k, v in self.phases.items()},
                 "counters": dict(self.counters),
                 "cache": dict(self.cache),
@@ -815,7 +846,7 @@ def _close_trace(trace: QueryTrace, error: Optional[BaseException]) -> None:
         logger.warning(
             "slow query (%.0f ms >= DSQL_SLOW_QUERY_MS=%.0f): %s | tier: %s "
             "| cacheHit: %s | priority: %s | skew: %s | collectives: %s "
-            "| costErr: %s | phases: %s | counters: %s%s",
+            "| costErr: %s | phases: %s | counters: %s%s%s",
             report.wall_ms, slow_ms, report.query.strip()[:500],
             report.tier or "eager", bool(report.cache.get("hit")),
             report.priority or "-",
@@ -824,9 +855,10 @@ def _close_trace(trace: QueryTrace, error: Optional[BaseException]) -> None:
             report.cost_err if report.cost_err is not None else "-",
             {k: round(v, 1) for k, v in sorted(report.phases.items())},
             dict(sorted(report.counters.items())),
-            # trace correlation suffix only when an ID exists, so the
-            # line stays byte-identical with the watchtower off
-            f" | trace: {report.trace_id}" if report.trace_id else "")
+            # trace/tenant correlation suffixes only when they exist, so
+            # the line stays byte-identical with the features off
+            f" | trace: {report.trace_id}" if report.trace_id else "",
+            f" | tenant: {report.tenant}" if report.tenant else "")
 
     _export_chrome_trace(report)
 

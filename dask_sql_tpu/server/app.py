@@ -72,6 +72,14 @@ ERROR_WIRE_MATRIX = {
     "AdmissionRejected": (429, "INSUFFICIENT_RESOURCES", "QUERY_QUEUE_FULL"),
     "AdmissionTimeout": (429, "INSUFFICIENT_RESOURCES",
                          "QUERY_QUEUE_TIMEOUT"),
+    # tenant quotas / circuit breakers (runtime/tenancy.py) and the
+    # burn-driven load shed (runtime/scheduler.py) ride the 429 +
+    # Retry-After path of their AdmissionRejected parent
+    "TenantQuotaExceeded": (429, "INSUFFICIENT_RESOURCES",
+                            "TENANT_QUOTA_EXCEEDED"),
+    "TenantCircuitOpen": (429, "INSUFFICIENT_RESOURCES",
+                          "TENANT_CIRCUIT_OPEN"),
+    "LoadShedRejected": (429, "INSUFFICIENT_RESOURCES", "SLO_LOAD_SHED"),
     "ServerDraining": (503, "INSUFFICIENT_RESOURCES",
                        "SERVER_SHUTTING_DOWN"),
     "SpillError": (200, "INTERNAL_ERROR", "SPILL_ERROR"),
@@ -84,6 +92,36 @@ def _events_on() -> bool:
     DSQL_EVENTS=0 keeps the wire byte-identical — no trace headers, no
     /v1/events route, no module import."""
     return os.environ.get("DSQL_EVENTS", "0").strip() not in ("", "0")
+
+
+def _tenancy_on() -> bool:
+    """Tenancy gate (runtime/tenancy.py): same env-before-import
+    discipline — DSQL_TENANCY=0 keeps the module un-imported and the
+    wire byte-identical (no tenant section, no tenant claims)."""
+    return os.environ.get("DSQL_TENANCY", "1").strip() not in ("", "0")
+
+
+def _page_rows() -> int:
+    """Result-paging threshold (``DSQL_RESULT_PAGE_ROWS``): results with
+    more rows spool into SpillStore pages of this many rows; 0 restores
+    the old single-shot payload bit-for-bit."""
+    try:
+        return max(int(os.environ.get("DSQL_RESULT_PAGE_ROWS", "")
+                       or 10_000), 0)
+    except ValueError:
+        return 10_000
+
+
+def _result_ttl_s() -> float:
+    """Reaper TTL (``DSQL_RESULT_TTL_S``): finished-but-never-collected
+    queries and abandoned result spools are garbage-collected this many
+    seconds after their last touch (0 disables reaping — the historical
+    leak-forever behavior)."""
+    try:
+        return max(float(os.environ.get("DSQL_RESULT_TTL_S", "") or 600.0),
+                   0.0)
+    except ValueError:
+        return 600.0
 
 
 def submit_status(exc: Exception) -> int:
@@ -204,7 +242,8 @@ def _run_tracked(context, sql: str, info: _QueryInfo,
                  cancel: Optional[threading.Event] = None,
                  seat: Optional[_sched.Seat] = None,
                  trace_id: Optional[str] = None,
-                 params: Optional[list] = None):
+                 params: Optional[list] = None,
+                 grant=None):
     from ..physical import compiled
     from contextlib import nullcontext
 
@@ -217,6 +256,17 @@ def _run_tracked(context, sql: str, info: _QueryInfo,
         tid_scope = _ev.trace_id_scope(trace_id)
     else:
         tid_scope = nullcontext()
+
+    # the POST-time tenant pre-claim rides in the same way: tenancy's
+    # admission (wrapping the plan execution) consumes it exactly once —
+    # mirroring the scheduler seat — so the token spent at the server
+    # boundary is the only token this query costs.  grant is only ever
+    # non-None when DSQL_TENANCY is armed.
+    if grant is not None:
+        from ..runtime import tenancy as _ten
+        g_scope = _ten.grant_scope(grant)
+    else:
+        g_scope = nullcontext()
 
     info.started = time.monotonic()
     c0 = dict(compiled.stats)
@@ -232,10 +282,16 @@ def _run_tracked(context, sql: str, info: _QueryInfo,
         # behind a fut.cancel() that cannot stop a started future.
         # seat_scope hands the POST-time admission pre-claim to the
         # workload manager, which consumes its timestamp + priority.
-        with tid_scope, _sched.seat_scope(seat), \
+        with tid_scope, g_scope, _sched.seat_scope(seat), \
                 _res.query_scope(cancel=cancel):
             table = context.sql(sql, params=params)
     finally:
+        if grant is not None:
+            # a grant the query never consumed (DDL, pre-plan failure)
+            # still holds a concurrency slot — give it back (idempotent:
+            # a consumed grant was already released with its outcome)
+            from ..runtime import tenancy as _ten
+            _ten.get_registry().release(grant)
         info.cpu_sec = time.thread_time() - cpu0
         info.finished = time.monotonic()
         info.compiles = compiled.stats["compiles"] - c0["compiles"]
@@ -316,6 +372,97 @@ def _data_payload(table) -> list:
 
 
 # ---------------------------------------------------------------------------
+# result spooling (ISSUE 17): large finished results page through the
+# SpillStore instead of riding one giant /v1/status payload
+# ---------------------------------------------------------------------------
+
+#: one SpillStore run per page — the store frees whole runs only, and
+#: per-page runs are what lets "pages free as fetched" actually free
+_RESULT_RUN_FMT = "__result__{uid}__p{page}"
+
+
+class _Spool:
+    """One spooled (paged) result.
+
+    Page 0 goes out inline with the final ``/v1/status`` response (so
+    the classic poll loop still sees columns+data); pages ``1..n-1``
+    live in the SpillStore as JSON-encoded uint8 chunks — byte-exact
+    with what ``_data_payload`` would have sent, and flushable to disk
+    under the store's ordinary host budget.  ``next_page`` is the lowest
+    page not yet freed: fetching page ``p`` frees everything below it
+    (clients may retry the page they are on after a network hiccup), and
+    the terminal page ``n`` carries no data, no ``nextUri``, and drops
+    the spool."""
+
+    __slots__ = ("uid", "columns", "pages", "page_bytes", "next_page",
+                 "trace_id", "created", "last_access")
+
+    def __init__(self, uid: str, columns: list, pages: int,
+                 page_bytes: Dict[int, int],
+                 trace_id: Optional[str] = None):
+        self.uid = uid
+        self.columns = columns
+        self.pages = pages              # data pages (page 0 included)
+        self.page_bytes = page_bytes    # stored page -> payload bytes
+        self.next_page = 1              # page 0 served inline
+        self.trace_id = trace_id
+        self.created = time.monotonic()
+        self.last_access = self.created
+
+    def live_bytes(self) -> int:
+        return sum(v for p, v in self.page_bytes.items()
+                   if p >= self.next_page)
+
+    def live_pages(self) -> int:
+        return max(self.pages - self.next_page, 0)
+
+
+def _spool_result(state: "_AppState", uid: str, table,
+                  info: Optional[_QueryInfo]):
+    """Spool ``table`` into pages; returns ``(spool, page0_rows)`` or
+    None when the result is small enough / paging is off / the spool
+    path faulted — the caller then serves the classic single-shot
+    payload (degraded, never broken)."""
+    pr = _page_rows()
+    if (pr <= 0 or table is None or not getattr(table, "num_columns", 0)
+            or int(table.num_rows) <= pr):
+        return None
+    import numpy as np
+    from ..runtime import spill as _spill
+    store = _spill.get_store()
+    stored = []
+    try:
+        _faults.maybe_fail("result_spool")
+        data = _data_payload(table)
+        n_pages = (len(data) + pr - 1) // pr
+        page_bytes: Dict[int, int] = {}
+        for p in range(1, n_pages):
+            chunk = data[p * pr:(p + 1) * pr]
+            body = json.dumps(chunk, separators=(",", ":"),
+                              default=str).encode()
+            run = _RESULT_RUN_FMT.format(uid=uid, page=p)
+            store.put_host(run, ["body"],
+                           [(np.frombuffer(body, dtype=np.uint8).copy(),
+                             None, "bytes", None)], rows=len(chunk))
+            stored.append(run)
+            page_bytes[p] = len(body)
+    except Exception as e:
+        for run in stored:
+            store.free_run(run)
+        logger.warning("result spool failed for %s (%s); serving the "
+                       "unpaged response", uid, e)
+        return None
+    spool = _Spool(uid, _columns_payload(table), n_pages, page_bytes,
+                   trace_id=getattr(info, "trace_id", None))
+    with state.lock:
+        state.spools[uid] = spool
+    _tel.inc("result_spooled")
+    _tel.inc("result_pages_spooled", len(stored))
+    state.publish_spool_gauges()
+    return spool, data[:pr]
+
+
+# ---------------------------------------------------------------------------
 # GET /v1/engine: one live snapshot of the whole engine
 # ---------------------------------------------------------------------------
 
@@ -364,7 +511,7 @@ def _engine_snapshot(state: "_AppState") -> dict:
             for uid, fut in state.future_list.items()]
     pstore = _pstore.get_store()
     qstore = _quar.get_store()
-    return {
+    out = {
         "pid": os.getpid(),
         "active": _fr.active_snapshot(),
         "serverQueries": server_queries,
@@ -405,6 +552,14 @@ def _engine_snapshot(state: "_AppState") -> dict:
         "profile": _profile_section(),
         "slo": _slo_section(),
     }
+    # feature-gated sections: absent with the kill switches thrown, so
+    # DSQL_RESULT_PAGE_ROWS=0 / DSQL_TENANCY=0 keep /v1/engine pre-PR
+    if _page_rows() > 0 or state.spools:
+        out["results"] = state.spools_snapshot()
+    if _tenancy_on():
+        from ..runtime import tenancy as _ten
+        out["tenants"] = _ten.get_registry().snapshot()
+    return out
 
 
 def _devices_section() -> list:
@@ -485,8 +640,131 @@ class _AppState:
         self.query_info: Dict[str, _QueryInfo] = {}
         self.cancel_events: Dict[str, threading.Event] = {}
         self.seats: Dict[str, _sched.Seat] = {}
+        self.spools: Dict[str, _Spool] = {}
         self.lock = threading.Lock()
         self.drained = threading.Event()     # set when a drain completed
+        # result/registry reaper (ISSUE 17): GCs never-collected results,
+        # abandoned spools and their registry entries after
+        # DSQL_RESULT_TTL_S — the fix for the historical future_list /
+        # query_info / seats leak when a client submits and walks away
+        self._reaper = threading.Thread(target=self._reap_loop,
+                                        name="dsql-result-reaper",
+                                        daemon=True)
+        self._reaper.start()
+
+    def forget(self, uid: str) -> tuple:
+        """The one true cleanup for a query's registry entries — status
+        collection, cancel, and the reaper all come through here (the
+        4-line pop block used to be duplicated across the status paths).
+        Hands an unconsumed admission seat back (idempotent) and returns
+        ``(future, info, cancel_event)`` for callers that still need
+        them — all None when the uid was already forgotten."""
+        with self.lock:
+            fut = self.future_list.pop(uid, None)
+            info = self.query_info.pop(uid, None)
+            cancel = self.cancel_events.pop(uid, None)
+            seat = self.seats.pop(uid, None)
+        _sched.get_manager().release_seat(seat)
+        return fut, info, cancel
+
+    # -- spool bookkeeping --------------------------------------------------
+    def publish_spool_gauges(self) -> None:
+        with self.lock:
+            pages = sum(s.live_pages() for s in self.spools.values())
+            nbytes = sum(s.live_bytes() for s in self.spools.values())
+        _tel.REGISTRY.set_gauge("result_spool_pages", pages)
+        _tel.REGISTRY.set_gauge("result_spool_bytes", nbytes)
+
+    def advance_spool(self, uid: str, page: int) -> None:
+        """The client fetched ``page``: every page below it was received,
+        so free their SpillStore runs (pages free as fetched)."""
+        with self.lock:
+            spool = self.spools.get(uid)
+            if spool is None:
+                return
+            lo = spool.next_page
+            spool.next_page = max(spool.next_page, page)
+        if lo < page:
+            from ..runtime import spill as _spill
+            store = _spill.get_store()
+            for p in range(max(lo, 1), page):
+                store.free_run(_RESULT_RUN_FMT.format(uid=uid, page=p))
+        self.publish_spool_gauges()
+
+    def drop_spool(self, uid: str) -> bool:
+        """Free a spool and every page it still holds (terminal page,
+        cancel, reaper)."""
+        with self.lock:
+            spool = self.spools.pop(uid, None)
+        if spool is None:
+            return False
+        from ..runtime import spill as _spill
+        store = _spill.get_store()
+        for p in range(max(spool.next_page, 1), spool.pages):
+            store.free_run(_RESULT_RUN_FMT.format(uid=uid, page=p))
+        self.publish_spool_gauges()
+        return True
+
+    def spools_snapshot(self) -> dict:
+        with self.lock:
+            return {
+                "enabled": _page_rows() > 0,
+                "pageRows": _page_rows(),
+                "ttlS": _result_ttl_s(),
+                "spools": len(self.spools),
+                "livePages": sum(s.live_pages()
+                                 for s in self.spools.values()),
+                "liveBytes": sum(s.live_bytes()
+                                 for s in self.spools.values()),
+            }
+
+    # -- reaper -------------------------------------------------------------
+    def _reap_loop(self) -> None:
+        while not self.drained.wait(0.25):
+            try:
+                self.reap_once()
+            except Exception:
+                logger.exception("result reaper tick failed")
+
+    def reap_once(self, now: Optional[float] = None) -> int:
+        """One reaper tick: forget finished-but-never-collected queries
+        and abandoned spools older than ``DSQL_RESULT_TTL_S``.  Returns
+        how many entries were reaped (tests drive this directly)."""
+        ttl = _result_ttl_s()
+        if ttl <= 0:
+            return 0
+        now = time.monotonic() if now is None else now
+        with self.lock:
+            dead_spools = [uid for uid, s in self.spools.items()
+                           if now - s.last_access > ttl]
+            dead_queries = []
+            for uid, fut in self.future_list.items():
+                if not fut.done():
+                    continue
+                info = self.query_info.get(uid)
+                done_at = getattr(info, "finished", None) or \
+                    getattr(info, "submitted", None) or now
+                if now - done_at > ttl:
+                    dead_queries.append(uid)
+        reaped = 0
+        for uid in dead_queries:
+            fut, _info, _cancel = self.forget(uid)
+            if fut is not None:
+                # consume the outcome so an abandoned failure does not
+                # warn at interpreter shutdown
+                try:
+                    fut.exception(timeout=0)
+                except Exception:
+                    pass
+                reaped += 1
+                logger.info("reaped never-collected query %s", uid)
+        for uid in dead_spools:
+            if self.drop_spool(uid):
+                reaped += 1
+                logger.info("reaped abandoned result spool %s", uid)
+        if reaped:
+            _tel.inc("result_reaped", reaped)
+        return reaped
 
 
 # ---------------------------------------------------------------------------
@@ -667,6 +945,23 @@ def _make_handler(state: _AppState, base_url: str):
                 uid = self.path[len("/v1/status/"):].strip("/")
                 fut = state.future_list.get(uid)
                 if fut is None:
+                    # a spooled result already collected its page 0: a
+                    # re-poll answers FINISHED with columns and the
+                    # nextUri of the lowest uncollected page (no data —
+                    # rows travel on /v1/result only, once each)
+                    with state.lock:
+                        spool = state.spools.get(uid)
+                    if spool is not None:
+                        spool.last_access = time.monotonic()
+                        self._send(200, {
+                            "id": uid, "infoUri": base_url,
+                            "nextUri": (f"{base_url}/v1/result/{uid}/"
+                                        f"{spool.next_page}"),
+                            "columns": spool.columns,
+                            "stats": _stats("FINISHED"),
+                        }, headers=self._trace_headers(
+                            tid=spool.trace_id))
+                        return
                     self._send(404, _error_payload("Unknown query id", uid),
                                headers=self._trace_headers())
                     return
@@ -682,18 +977,25 @@ def _make_handler(state: _AppState, base_url: str):
                 try:
                     table = fut.result()
                 except Exception as e:
-                    del state.future_list[uid]
-                    state.query_info.pop(uid, None)
-                    state.cancel_events.pop(uid, None)
-                    state.seats.pop(uid, None)
+                    state.forget(uid)
                     _tel.inc("server_query_errors")
                     self._send(200, _error_payload(str(e), uid, exc=e),
                                headers=self._trace_headers(info))
                     return
-                del state.future_list[uid]
-                state.query_info.pop(uid, None)
-                state.cancel_events.pop(uid, None)
-                state.seats.pop(uid, None)
+                spooled = _spool_result(state, uid, table, info)
+                state.forget(uid)
+                if spooled is not None:
+                    # page 0 inline + a REAL nextUri: the rest of the
+                    # result pages through GET /v1/result/{uid}/{page}
+                    spool, page0 = spooled
+                    self._send(200, {
+                        "id": uid, "infoUri": base_url,
+                        "nextUri": f"{base_url}/v1/result/{uid}/1",
+                        "columns": spool.columns,
+                        "data": page0,
+                        "stats": _stats("FINISHED", info),
+                    }, headers=self._trace_headers(info))
+                    return
                 payload = {
                     "id": uid, "infoUri": base_url,
                     "stats": _stats("FINISHED", info),
@@ -704,7 +1006,73 @@ def _make_handler(state: _AppState, base_url: str):
                 self._send(200, payload,
                            headers=self._trace_headers(info))
                 return
+            if self.path.startswith("/v1/result/"):
+                parts = self.path[len("/v1/result/"):].strip("/").split("/")
+                page = -1
+                if len(parts) == 2:
+                    try:
+                        page = int(parts[1])
+                    except ValueError:
+                        page = -1
+                if page < 0:
+                    self._send(404, {"error": "not found"})
+                    return
+                self._serve_result_page(parts[0], page)
+                return
             self._send(404, {"error": "not found"})
+
+        def _serve_result_page(self, uid: str, page: int):
+            """GET /v1/result/{uid}/{page}: one spooled page.  Pages are
+            served in order; fetching page p frees every page below it,
+            a page below ``next_page`` is 410 Gone (collected and
+            freed), and the terminal page (== page count) answers empty
+            data with no nextUri and drops the spool."""
+            with state.lock:
+                spool = state.spools.get(uid)
+            if spool is None:
+                self._send(404, _error_payload(
+                    "Unknown or expired result id", uid),
+                    headers=self._trace_headers())
+                return
+            spool.last_access = time.monotonic()
+            hdrs = self._trace_headers(tid=spool.trace_id)
+            if page < spool.next_page or page > spool.pages:
+                self._send(410, _error_payload(
+                    f"result page {page} of {uid} already collected "
+                    f"(pages free as fetched; next is "
+                    f"{spool.next_page})", uid), headers=hdrs)
+                return
+            if page == spool.pages:
+                # terminal page: no data, no nextUri — the client has
+                # everything, free whatever is left
+                state.drop_spool(uid)
+                _tel.inc("result_pages_served")
+                self._send(200, {
+                    "id": uid, "infoUri": base_url,
+                    "columns": spool.columns, "data": [],
+                    "stats": _stats("FINISHED"),
+                }, headers=hdrs)
+                return
+            from ..runtime import spill as _spill
+            try:
+                _names, cols = _spill.get_store().get_host_cols(
+                    _RESULT_RUN_FMT.format(uid=uid, page=page), 0)
+                rows = json.loads(cols[0][0].tobytes().decode())
+            except Exception as e:
+                logger.exception("result page fetch failed: %s/%d",
+                                 uid, page)
+                self._send(500, _error_payload(
+                    f"result page fetch failed: {e}", uid, exc=e),
+                    headers=hdrs)
+                return
+            state.advance_spool(uid, page)
+            _tel.inc("result_pages_served")
+            self._send(200, {
+                "id": uid, "infoUri": base_url,
+                "nextUri": f"{base_url}/v1/result/{uid}/{page + 1}",
+                "columns": spool.columns, "data": rows,
+                "stats": _stats("FINISHED"),
+            }, headers=hdrs)
 
         def _serve_events(self):
             """GET /v1/events?cursor=N&timeout_ms=M&limit=K — newline-
@@ -805,6 +1173,21 @@ def _make_handler(state: _AppState, base_url: str):
                 _tel.inc("server_drain_rejects")
                 reject(mgr._drain_verdict())
                 return
+            # tenant pre-claim FIRST (runtime/tenancy.py, X-DSQL-Tenant
+            # header): a tenant over its rate/concurrency quota or with
+            # an open circuit gets its typed 429 before a scheduler seat
+            # or queue position is spent on it.  grant stays None with
+            # DSQL_TENANCY=0 (no import — wire byte-identical).
+            grant = None
+            if _tenancy_on():
+                from ..runtime import tenancy as _ten
+                try:
+                    grant = _ten.get_registry().claim(
+                        self.headers.get("X-DSQL-Tenant"))
+                except _res.AdmissionRejected as e:
+                    _tel.inc("server_throttled")
+                    reject(e)
+                    return
             # admission pre-claim at POST time: when every slot AND queue
             # position is taken the client gets an immediate 429 with a
             # Retry-After hint, instead of the query disappearing into an
@@ -814,6 +1197,9 @@ def _make_handler(state: _AppState, base_url: str):
             try:
                 seat = mgr.claim_seat(priority)
             except _res.AdmissionRejected as e:
+                if grant is not None:
+                    from ..runtime import tenancy as _ten
+                    _ten.get_registry().release(grant)
                 _tel.inc("server_drain_rejects"
                          if isinstance(e, _res.ServerDraining)
                          else "server_throttled")
@@ -827,7 +1213,7 @@ def _make_handler(state: _AppState, base_url: str):
             if seat is not None:
                 state.seats[uid] = seat
             fut = state.pool.submit(_run_tracked, state.context, sql, info,
-                                    cancel, seat, tid, params)
+                                    cancel, seat, tid, params, grant)
             state.future_list[uid] = fut
             self._send(200, {
                 "id": uid, "infoUri": base_url,
@@ -840,19 +1226,23 @@ def _make_handler(state: _AppState, base_url: str):
         def do_DELETE(self):
             if self.path.startswith("/v1/cancel/"):
                 uid = self.path[len("/v1/cancel/"):].strip("/")
-                fut = state.future_list.pop(uid, None)
-                info = state.query_info.pop(uid, None)
-                cancel = state.cancel_events.pop(uid, None)
-                seat = state.seats.pop(uid, None)
-                if fut is None:
+                # forget() pops every registry dict and hands an
+                # unconsumed admission pre-claim back (a query cancelled
+                # while still in the pool backlog never reaches
+                # _run_tracked — its seat must not hold a queue position
+                # forever; idempotent: a consumed seat is a no-op)
+                fut, info, cancel = state.forget(uid)
+                # a cancel can also target a spooled result mid-page:
+                # drop the spool and free its remaining pages
+                dropped = state.drop_spool(uid)
+                if fut is None and not dropped:
                     self._send(404, _error_payload("Unknown query id", uid),
                                headers=self._trace_headers())
                     return
-                # a query cancelled while still in the pool backlog never
-                # reaches _run_tracked — its admission pre-claim must not
-                # hold a queue position forever (idempotent: a consumed
-                # seat is a no-op)
-                _sched.get_manager().release_seat(seat)
+                if fut is None:
+                    _tel.inc("server_cancels")
+                    self._send(200, None, headers=self._trace_headers())
+                    return
                 # REAL cancellation, not just fut.cancel() (which is a
                 # no-op once the future started): the cancel token makes
                 # the running query raise QueryCancelled at its next

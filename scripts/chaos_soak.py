@@ -11,7 +11,15 @@ the ROADMAP's serving story needs (run by scripts/ci_local.sh as
   * ``--clients`` concurrent client threads (default 4) submit random
     queries from a fixed menu (agg / join+agg / filter+topk / global agg /
     chunked streaming) at random priorities through the armed workload
-    manager (2 slots) for ``--budget-s`` seconds;
+    manager (2 slots) for ``--budget-s`` seconds — each tagged with a
+    tenant identity (``t0``/``t1``) so the per-tenant accounting and the
+    armed circuit breaker (``DSQL_TENANT_BREAKER``; the rare FATAL faults
+    feed it) see real mixed traffic;
+  * one HTTP client drives a live server with small
+    ``DSQL_RESULT_PAGE_ROWS``: it submits a 2000-row query under the
+    ``web`` tenant and either drains the whole ``nextUri`` page chain
+    (oracle-checked) or DISCONNECTS mid-pagination, leaving the reaper
+    (``DSQL_RESULT_TTL_S``) to GC the abandoned pages and futures;
   * one MV-churn client appends random batches into its own base table
     and reads a maintained materialized view against a self-maintained
     pandas oracle — the ``mv_refresh`` site makes incremental refreshes
@@ -31,20 +39,27 @@ Engine-wide invariants asserted at the end — the acceptance bar:
      (result or typed ResilienceError) and every client thread joins;
   3. ZERO untyped failures escaping the engine;
   4. counters reconcile: admitted + rejected + timeout + injected
-     admission faults == submissions, and the scheduler ends with no
-     running slots or queue ghosts;
-  5. the engine is healthy AFTER the soak: with faults disarmed, every
+     admission faults + tenant quota/circuit rejects == submissions
+     (ctx AND wire clients), per-tenant submitted == admitted + rejects
+     with zero inflight grants, and the scheduler ends with no running
+     slots or queue ghosts;
+  5. nothing leaks: the reaper clears every abandoned spool/future/seat
+     and the spill store ends with zero runs;
+  6. the engine is healthy AFTER the soak: with faults disarmed, every
      menu query answers oracle-correct.
 
 Exit 0 on success.
 """
 import argparse
+import json
 import os
 import random
 import sys
 import tempfile
 import threading
 import time
+import urllib.error
+import urllib.request
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # this gate asserts SYNCHRONOUS compile behavior; tiered execution
@@ -64,6 +79,16 @@ os.environ.setdefault("DSQL_SPILL_DIR",
 # stage every multi-heavy plan so the stage-exec/stage-replay failure
 # domain is actually in play on the small soak queries
 os.environ.setdefault("DSQL_STAGE_HEAVY", "1")
+# small pages + a short TTL put the result spooler and its reaper in the
+# blast radius: the HTTP client pages 2000-row results 200 rows at a
+# time and ABANDONS half of them mid-chain for the reaper to GC
+os.environ.setdefault("DSQL_RESULT_PAGE_ROWS", "200")
+os.environ.setdefault("DSQL_RESULT_TTL_S", "3")
+# arm the per-tenant circuit breaker so the rare FATAL compile faults
+# exercise trip -> open -> half-open probe -> close IN-SOAK
+os.environ.setdefault("DSQL_TENANT_BREAKER", "3")
+os.environ.setdefault("DSQL_TENANT_BREAKER_TTL_S", "2")
+os.environ.setdefault("DSQL_TENANT_BREAKER_PROBE_S", "1")
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -157,6 +182,8 @@ def main(argv=None) -> int:
     from dask_sql_tpu.runtime import resilience as res
     from dask_sql_tpu.runtime import scheduler as sched
     from dask_sql_tpu.runtime import telemetry as tel
+    from dask_sql_tpu.runtime import tenancy
+    from dask_sql_tpu.server.app import run_server
 
     t1, t2 = _make_data(args.seed)
     ctx = Context()
@@ -182,9 +209,16 @@ def main(argv=None) -> int:
     spec += f",compile:p={args.p / 5:.4f}:seed={args.seed + 100}:fatal"
     os.environ["DSQL_FAULT_INJECT"] = spec
 
+    # the wire client's server shares ctx, scheduler and spill store with
+    # the in-process clients — the composition under test
+    srv = run_server(context=ctx, host="127.0.0.1", port=0, blocking=False)
+    base = f"http://127.0.0.1:{srv.server_port}"
+
     c0 = tel.REGISTRY.counters()
     lock = threading.Lock()
     stats = {"submitted": 0, "ok": 0, "typed": 0, "untyped": 0, "wrong": 0}
+    http = {"submitted": 0, "ok": 0, "typed": 0, "abandoned": 0,
+            "untyped": 0, "wrong": 0}
     problems = []
 
     t_end = time.monotonic() + args.budget_s
@@ -198,7 +232,8 @@ def main(argv=None) -> int:
                 stats["submitted"] += 1
             try:
                 got = ctx.sql(sql, return_futures=False,
-                              timeout=QUERY_TIMEOUT_S, priority=pr)
+                              timeout=QUERY_TIMEOUT_S, priority=pr,
+                              tenant=f"t{tid % 2}")
             except res.ResilienceError:
                 with lock:
                     stats["typed"] += 1
@@ -270,9 +305,96 @@ def main(argv=None) -> int:
             with lock:
                 stats["ok"] += 1
 
+    def paging_client() -> None:
+        # the wire-level tenant: pages 2000-row results through the spool
+        # and walks away from half of them mid-chain (disconnect), leaving
+        # the reaper to prove the no-leak invariant
+        rng = random.Random(args.seed * 1000 + 8888)
+        sql = "SELECT k, v FROM t1"
+        oracle = t1[["k", "v"]]
+
+        def fetch(url, body=None):
+            req = urllib.request.Request(
+                url, data=body, method="POST" if body else "GET",
+                headers={"X-DSQL-Tenant": "web"} if body else {})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read())
+
+        while time.monotonic() < t_end:
+            with lock:
+                http["submitted"] += 1
+            bail_after = rng.randrange(1, 8) if rng.random() < 0.5 else None
+            try:
+                payload = fetch(f"{base}/v1/statement", sql.encode())
+                deadline = time.monotonic() + QUERY_TIMEOUT_S + 60
+                rows, pages, failed, abandoned = [], 0, False, False
+                while True:
+                    if payload.get("stats", {}).get("state") == "FAILED":
+                        failed = True
+                        break
+                    if payload.get("data"):
+                        rows.extend(payload["data"])
+                        pages += 1
+                    uri = payload.get("nextUri")
+                    if uri is None:
+                        break
+                    if ("/v1/result/" in uri and bail_after is not None
+                            and pages >= bail_after):
+                        abandoned = True   # hang up with pages spooled
+                        break
+                    if time.monotonic() > deadline:
+                        raise TimeoutError("paging poll hung")
+                    payload = fetch(uri)
+            except urllib.error.HTTPError as e:
+                # typed iff the wire payload carries an audited errorName
+                # (429 quota/shed, 5xx fault verdicts); anything else is
+                # an escape
+                try:
+                    err = json.loads(e.read()).get("error", {})
+                except Exception:  # noqa: BLE001
+                    err = {}
+                with lock:
+                    if err.get("errorName"):
+                        http["typed"] += 1
+                    else:
+                        http["untyped"] += 1
+                        problems.append("untyped wire failure: HTTP "
+                                        f"{e.code} without an errorName")
+                if e.code == 429:
+                    time.sleep(0.2)
+                continue
+            except Exception as e:  # noqa: BLE001 - the gate records it
+                with lock:
+                    http["untyped"] += 1
+                    problems.append("untyped paging-client failure: "
+                                    f"{type(e).__name__}: {e}")
+                continue
+            if failed:
+                with lock:
+                    http["typed"] += 1
+                continue
+            if abandoned:
+                with lock:
+                    http["abandoned"] += 1
+                continue
+            try:
+                got = pd.DataFrame(rows, columns=["k", "v"])
+                pd.testing.assert_frame_equal(
+                    _norm(got), _norm(oracle), check_dtype=False,
+                    rtol=1e-6, atol=1e-9)
+            except AssertionError as e:
+                with lock:
+                    http["wrong"] += 1
+                    problems.append("WRONG RESULT over the paged wire: "
+                                    f"{str(e)[:300]}")
+                continue
+            with lock:
+                http["ok"] += 1
+
     threads = [threading.Thread(target=client, args=(i,), daemon=True)
                for i in range(args.clients)]
     threads.append(threading.Thread(target=mv_client, daemon=True))
+    threads.append(threading.Thread(target=paging_client, daemon=True))
     for th in threads:
         th.start()
     hung = 0
@@ -281,6 +403,21 @@ def main(argv=None) -> int:
         th.join(timeout=max(join_by - time.monotonic(), 0.1))
         if th.is_alive():
             hung += 1
+
+    # the reaper must clear every abandoned pagination (spool + future +
+    # seat) on its own within DSQL_RESULT_TTL_S; only then stop the server
+    state = srv.app_state
+    reap_by = time.monotonic() + 20
+    while time.monotonic() < reap_by and (
+            state.spools or state.future_list or state.seats):
+        time.sleep(0.1)
+    if state.spools or state.future_list or state.seats:
+        problems.append(
+            "reaper leaked server state past the TTL: "
+            f"spools={len(state.spools)} futures={len(state.future_list)} "
+            f"seats={len(state.seats)} "
+            f"(abandoned paginations: {http['abandoned']})")
+    srv.shutdown()
 
     c1 = tel.REGISTRY.counters()
 
@@ -301,6 +438,20 @@ def main(argv=None) -> int:
         failures.append("outcome counts do not sum to submissions")
     if stats["ok"] == 0:
         failures.append("no query succeeded — the soak proved nothing")
+    if http["wrong"]:
+        failures.append(f"{http['wrong']} wrong result(s) over the paged "
+                        "wire")
+    if http["untyped"]:
+        failures.append(f"{http['untyped']} untyped wire failure(s)")
+    if sum(http[k] for k in ("ok", "typed", "abandoned", "untyped",
+                             "wrong")) != http["submitted"]:
+        failures.append("wire outcome counts do not sum to submissions")
+    if http["ok"] == 0:
+        failures.append("no paged query fully drained — the spooler was "
+                        "never proven under chaos")
+    if http["abandoned"] == 0:
+        failures.append("no pagination was abandoned — the reaper was "
+                        "never exercised")
 
     # scheduler reconciliation: every submission enters admission exactly
     # once and leaves as admitted | rejected | timeout | injected fault
@@ -309,20 +460,37 @@ def main(argv=None) -> int:
     rejected = sum(d(f"sched_rejected_{p}") for p in PRIORITIES)
     timeout = sum(d(f"sched_timeout_{p}") for p in PRIORITIES)
     adm_faults = d("fault_admission")
-    accounted = admitted + rejected + timeout + adm_faults
-    if accounted != stats["submitted"]:
+    # tenant rejects fire BEFORE the scheduler sees the query, so they
+    # join the equation on the left
+    ten_rejects = d("tenant_quota_rejects") + d("tenant_circuit_rejects")
+    accounted = admitted + rejected + timeout + adm_faults + ten_rejects
+    submitted_all = stats["submitted"] + http["submitted"]
+    if accounted != submitted_all:
         failures.append(
             f"admission counters do not reconcile: admitted {admitted} + "
             f"rejected {rejected} + timeout {timeout} + injected "
-            f"{adm_faults} = {accounted} != submitted {stats['submitted']}")
+            f"{adm_faults} + tenant rejects {ten_rejects} = {accounted} "
+            f"!= submitted {submitted_all}")
+    # per-tenant books must balance too, with no grant left inflight
+    for row in tenancy.tenant_rows():
+        if row["inflight"]:
+            failures.append(f"tenant {row['tenant']!r} leaked "
+                            f"{row['inflight']} inflight grant(s)")
+        if row["submitted"] != (row["admitted"] + row["quota_rejects"]
+                                + row["circuit_rejects"]):
+            failures.append(f"tenant {row['tenant']!r} admission counters "
+                            f"do not reconcile: {row}")
     if mgr.running_count() != 0 or mgr.queue_depth() != 0:
         failures.append(
             f"scheduler leaked state: running={mgr.running_count()} "
             f"queued={mgr.queue_depth()} after the soak")
 
-    # post-soak health: faults disarmed, every menu query oracle-correct
+    # post-soak health: faults disarmed, every menu query oracle-correct.
+    # The per-tenant books were audited above; a breaker legitimately open
+    # at soak end must not fail the health probes, so clear the registry.
     os.environ.pop("DSQL_FAULT_INJECT", None)
     faults.reset()
+    tenancy.get_registry()._reset_for_tests()
     for sql, oracle in menu:
         try:
             got = ctx.sql(sql, return_futures=False, timeout=QUERY_TIMEOUT_S)
@@ -354,9 +522,17 @@ def main(argv=None) -> int:
           f"{stats['ok']} ok, {stats['typed']} typed failures, "
           f"{stats['wrong']} wrong, {stats['untyped']} untyped, "
           f"{hung} hung")
+    print(f"  paged wire: {http['submitted']} submitted -> {http['ok']} "
+          f"drained, {http['abandoned']} abandoned mid-page, "
+          f"{http['typed']} typed, {http['wrong']} wrong, "
+          f"{http['untyped']} untyped; "
+          f"{d('result_pages_served')} pages served, "
+          f"{d('result_reaped')} reaped")
     print("  admission: "
           f"admitted={admitted} rejected={rejected} timeout={timeout} "
-          f"injected={adm_faults}")
+          f"injected={adm_faults} tenant_rejects={ten_rejects} "
+          f"(circuit opens={d('tenant_circuit_opens')} "
+          f"probes={d('tenant_circuit_probes')})")
     print("  faults fired: " + (", ".join(
         f"{k[len('fault_'):]}={v}" for k, v in sorted(fault_counts.items()))
         or "none"))

@@ -120,6 +120,15 @@ echo "=== [2o] param smoke (parameterized plan identity) ==="
 # DSQL_PARAM_PLANS=0 must restore value-baked program identity
 python scripts/param_smoke.py
 
+echo "=== [2p] fleet smoke (result paging + tenant quotas + kill switches) ==="
+# a ~1M-row result must page through the spool behind a real nextUri with
+# the peak single response under 10% of the whole, a noisy tenant on a
+# 2-slot server must be throttled (429 + honest Retry-After) while a quiet
+# tenant loses zero queries, a client that disconnects mid-pagination must
+# be fully reaped within DSQL_RESULT_TTL_S (no /v1/engine occupancy), and
+# DSQL_RESULT_PAGE_ROWS=0 / DSQL_TENANCY=0 must restore the pre-armor wire
+python scripts/fleet_smoke.py
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \

@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Fleet-armor smoke gate: result paging, tenant quotas, and kill switches.
+
+Run by scripts/ci_local.sh (mirroring scripts/events_smoke.py):
+
+    python scripts/fleet_smoke.py
+
+Against ONE live server (env knobs are read per call, so phases flip
+them without a restart) the gate proves
+
+  1. a ~1M-row result pages through the spool behind a REAL ``nextUri``
+     chain: every row arrives exactly once, the PEAK single-response
+     payload stays under 10% of the whole, and the spill store is empty
+     once the client drains the chain;
+  2. a noisy tenant hammering a 2-slot server is throttled — 429 with an
+     honest ``Retry-After`` it can actually sleep on — while a quiet
+     tenant inside its own quota loses ZERO queries;
+  3. a client that disconnects mid-pagination leaks nothing: within
+     ``DSQL_RESULT_TTL_S`` the reaper frees its remaining pages AND its
+     ``future_list``/seat entries, so ``/v1/engine`` shows no occupancy
+     and the scheduler ends idle;
+  4. both kill switches restore the pre-paging wire behavior:
+     ``DSQL_RESULT_PAGE_ROWS=0`` serves the classic single-shot payload
+     (same key set, whole result inline) and ``DSQL_TENANCY=0`` admits
+     the noisy tenant unthrottled with no ``tenants`` engine section.
+
+Exit 0 on success.
+"""
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("DSQL_TIERED", "0")
+os.environ["DSQL_MAX_CONCURRENT_QUERIES"] = "2"
+os.environ.setdefault("DSQL_QUEUE_DEPTH", "64")
+os.environ.setdefault("DSQL_QUEUE_TIMEOUT_MS", "120000")
+os.environ.setdefault("DSQL_SPILL_DIR",
+                      tempfile.mkdtemp(prefix="dsql_fleet_spill_"))
+os.environ["DSQL_RESULT_PAGE_ROWS"] = "50000"
+os.environ["DSQL_RESULT_TTL_S"] = "600"
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+
+from dask_sql_tpu import Context  # noqa: E402
+from dask_sql_tpu.runtime import scheduler as sched  # noqa: E402
+from dask_sql_tpu.runtime import spill as spill_mod  # noqa: E402
+from dask_sql_tpu.server.app import run_server  # noqa: E402
+
+BIG_ROWS = 1_000_000
+PAGE_ROWS = 50_000
+CLASSIC_KEYS = ["columns", "data", "id", "infoUri", "stats"]
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _post(base, sql, tenant=None):
+    headers = {"X-DSQL-Tenant": tenant} if tenant else {}
+    req = urllib.request.Request(f"{base}/v1/statement", data=sql.encode(),
+                                 method="POST", headers=headers)
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=120) as r:
+        raw = r.read()
+        return json.loads(raw), len(raw)
+
+
+def _poll(base, payload, timeout=120):
+    """Follow /v1/status until the query finishes (a payload carrying
+    data, or a nextUri that points at /v1/result)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        uri = payload.get("nextUri")
+        if uri is None or "/v1/result/" in uri or "data" in payload:
+            return payload
+        time.sleep(0.05)
+        payload, _ = _get(uri)
+    raise AssertionError("query did not finish in time")
+
+
+def _drain(base, sql, tenant=None):
+    """Submit, poll, and walk the full page chain; returns
+    (rows, [response_payload_bytes])."""
+    payload = _poll(base, _post(base, sql, tenant=tenant))
+    rows, sizes = [], []
+    while True:
+        data = payload.get("data")
+        if data:
+            rows.extend(data)
+        sizes.append(len(json.dumps(payload).encode()))
+        uri = payload.get("nextUri")
+        if uri is None:
+            return rows, sizes
+        payload, _ = _get(uri)
+
+
+def main() -> int:  # noqa: C901 - one linear smoke script
+    ctx = Context()
+    ctx.create_table("big", pd.DataFrame(
+        {"a": np.arange(BIG_ROWS, dtype=np.int64)}))
+    ctx.create_table("small", pd.DataFrame(
+        {"a": np.arange(500, dtype=np.int64)}))
+    srv = run_server(context=ctx, host="127.0.0.1", port=0, blocking=False)
+    base = f"http://127.0.0.1:{srv.server_port}"
+    state = srv.app_state
+    try:
+        # -- 1. the ~1M-row result pages, every row exactly once -----------
+        rows, sizes = _drain(base, "SELECT a FROM big")
+        if len(rows) != BIG_ROWS:
+            return fail(f"paged result lost rows: {len(rows)} != {BIG_ROWS}")
+        got = np.fromiter((r[0] for r in rows), dtype=np.int64,
+                          count=BIG_ROWS)
+        if not np.array_equal(np.sort(got), np.arange(BIG_ROWS)):
+            return fail("paged result corrupted rows")
+        peak, total = max(sizes), sum(sizes)
+        if peak >= total * 0.10:
+            return fail(f"peak single response {peak}B is >= 10% of the "
+                        f"{total}B whole — paging is not actually paging")
+        if spill_mod.get_store().stats()["runs"] or state.spools:
+            return fail("pages leaked after a fully-drained chain")
+        print(f"ok paging: {BIG_ROWS} rows over {len(sizes)} responses, "
+              f"peak {peak / total:.1%} of {total >> 20} MiB total")
+
+        # -- 2. noisy tenant throttled, quiet tenant loses zero ------------
+        os.environ["DSQL_TENANT_QPS"] = "3"
+        noisy = {"ok": 0, "throttled": 0, "bad_hint": 0, "other": 0}
+        stop = time.time() + 4.0
+
+        def noisy_client():
+            while time.time() < stop:
+                try:
+                    p = _poll(base, _post(base, "SELECT COUNT(*) AS n "
+                                                "FROM small",
+                                          tenant="noisy"))
+                    noisy["ok"] += 1 if p.get("data") else 0
+                except urllib.error.HTTPError as e:
+                    if e.code == 429:
+                        ra = int(e.headers.get("Retry-After", "0"))
+                        if 1 <= ra <= 5:
+                            noisy["throttled"] += 1
+                            time.sleep(min(ra, 0.5))  # the hint is usable
+                        else:
+                            noisy["bad_hint"] += 1
+                    else:
+                        noisy["other"] += 1
+
+        th = threading.Thread(target=noisy_client, daemon=True)
+        th.start()
+        quiet_ok = 0
+        for _ in range(6):
+            p = _poll(base, _post(base, "SELECT SUM(a) AS s FROM small",
+                                  tenant="quiet"))
+            if p.get("data") == [[499 * 500 // 2]]:
+                quiet_ok += 1
+            time.sleep(0.5)
+        th.join(timeout=30)
+        os.environ.pop("DSQL_TENANT_QPS")
+        if th.is_alive():
+            return fail("noisy client hung")
+        if noisy["throttled"] == 0:
+            return fail(f"noisy tenant was never throttled: {noisy}")
+        if noisy["bad_hint"] or noisy["other"]:
+            return fail(f"throttle without an honest Retry-After: {noisy}")
+        if noisy["ok"] == 0:
+            return fail("noisy tenant was starved outright — the quota "
+                        "should pace, not ban")
+        if quiet_ok != 6:
+            return fail(f"quiet tenant lost {6 - quiet_ok} of 6 queries "
+                        "to a NOISY NEIGHBOR's pressure")
+        eng, _ = _get(f"{base}/v1/engine")
+        if not eng.get("tenants", {}).get("enabled"):
+            return fail("/v1/engine has no tenants section while tenancy "
+                        "is on")
+        from dask_sql_tpu.runtime import tenancy
+        rows = {r["tenant"]: r for r in tenancy.tenant_rows()}
+        if rows.get("noisy", {}).get("quota_rejects", 0) == 0:
+            return fail("system.tenants does not account the noisy "
+                        "tenant's rejects")
+        if rows["noisy"]["submitted"] != (rows["noisy"]["admitted"]
+                                          + rows["noisy"]["quota_rejects"]
+                                          + rows["noisy"]["circuit_rejects"]):
+            return fail("noisy tenant's admission counters do not "
+                        f"reconcile: {rows['noisy']}")
+        print(f"ok tenants: noisy {noisy['ok']} ok + {noisy['throttled']} "
+              f"throttled (honest hints), quiet 6/6")
+
+        # -- 3. disconnect-mid-page: the reaper closes every tab -----------
+        payload = _poll(base, _post(base, "SELECT a FROM big",
+                                    tenant="flaky"))
+        uid = payload["id"]
+        _get(payload["nextUri"])            # take page 1... then vanish
+        if uid not in state.spools:
+            return fail("mid-pagination spool missing before the TTL")
+        os.environ["DSQL_RESULT_TTL_S"] = "1"
+        deadline = time.time() + 15
+        while time.time() < deadline and (
+                state.spools or state.future_list or state.seats):
+            time.sleep(0.1)
+        os.environ["DSQL_RESULT_TTL_S"] = "600"
+        if state.spools or state.future_list or state.seats:
+            return fail("reaper did not GC the disconnected client within "
+                        f"the TTL: spools={list(state.spools)} "
+                        f"futures={list(state.future_list)} "
+                        f"seats={list(state.seats)}")
+        if spill_mod.get_store().stats()["runs"]:
+            return fail("disconnected client leaked spooled pages")
+        eng, _ = _get(f"{base}/v1/engine")
+        if eng["serverQueries"]:
+            return fail(f"/v1/engine still lists occupancy after the reap: "
+                        f"{eng['serverQueries']}")
+        mgr = sched.get_manager()
+        if mgr.running_count() != 0 or mgr.queue_depth() != 0:
+            return fail("scheduler seats leaked past the reap: "
+                        f"running={mgr.running_count()} "
+                        f"queued={mgr.queue_depth()}")
+        print("ok reaper: abandoned pages + future + seat GC'd within the "
+              "TTL, zero /v1/engine occupancy")
+
+        # -- 4. kill switches restore the pre-PR wire behavior -------------
+        os.environ["DSQL_RESULT_PAGE_ROWS"] = "0"
+        payload = _poll(base, _post(base, "SELECT a FROM small"))
+        if sorted(payload.keys()) != CLASSIC_KEYS:
+            return fail("DSQL_RESULT_PAGE_ROWS=0 payload keys drifted: "
+                        f"{sorted(payload.keys())} != {CLASSIC_KEYS}")
+        if len(payload["data"]) != 500 or "nextUri" in payload:
+            return fail("DSQL_RESULT_PAGE_ROWS=0 did not restore the "
+                        "single-shot result")
+        os.environ["DSQL_TENANCY"] = "0"
+        os.environ["DSQL_TENANT_QPS"] = "1"   # would throttle if consulted
+        for _ in range(8):
+            payload = _poll(base, _post(base, "SELECT COUNT(*) AS n "
+                                              "FROM small",
+                                        tenant="noisy"))
+            if payload.get("data") != [[500]]:
+                return fail("DSQL_TENANCY=0 altered a query result")
+            if sorted(payload.keys()) != CLASSIC_KEYS:
+                return fail("DSQL_TENANCY=0 payload keys drifted: "
+                            f"{sorted(payload.keys())}")
+        eng, _ = _get(f"{base}/v1/engine")
+        if "tenants" in eng:
+            return fail("DSQL_TENANCY=0 still surfaces a tenants section")
+        os.environ.pop("DSQL_TENANT_QPS")
+        os.environ.pop("DSQL_TENANCY")
+        os.environ["DSQL_RESULT_PAGE_ROWS"] = "50000"
+        print("ok kill switches: PAGE_ROWS=0 single-shot payload restored, "
+              "TENANCY=0 admits 8/8 unthrottled with no tenants surface")
+    finally:
+        srv.shutdown()
+
+    print("fleet smoke PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,205 @@
+"""Integration tests for spooled result paging (server/app.py, ISSUE 17):
+large results page through the SpillStore behind a REAL nextUri, pages
+free as fetched, the reaper GCs abandoned results AND the historical
+future_list leak, and the DSQL_RESULT_PAGE_ROWS=0 kill switch restores
+the classic single-shot payload bit-for-bit."""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu.runtime import faults
+from dask_sql_tpu.runtime import spill as spill_mod
+from dask_sql_tpu.runtime import telemetry as tel
+
+ROWS = 1050
+PAGE = 100
+
+
+@pytest.fixture()
+def server(monkeypatch, tmp_path):
+    monkeypatch.setenv("DSQL_RESULT_PAGE_ROWS", str(PAGE))
+    monkeypatch.setenv("DSQL_RESULT_TTL_S", "60")
+    monkeypatch.setenv("DSQL_SPILL_DIR", str(tmp_path))
+    from dask_sql_tpu.context import Context
+    from dask_sql_tpu.server.app import run_server
+
+    context = Context()
+    context.create_table("t", pd.DataFrame({
+        "a": np.arange(ROWS, dtype=np.int64),
+        "b": np.arange(ROWS, dtype=np.float64) * 2.0,
+    }))
+    srv = run_server(context=context, host="127.0.0.1", port=0,
+                     blocking=False)
+    yield srv, f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def _post(base, sql, headers=None):
+    req = urllib.request.Request(f"{base}/v1/statement", data=sql.encode(),
+                                 method="POST", headers=headers or {})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read()), dict(r.headers)
+
+
+def _poll_until_done(base, payload, timeout=60):
+    """Follow the classic status loop until the response stops pointing
+    at /v1/status (done: either a final payload or a /v1/result link)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        uri = payload.get("nextUri")
+        if uri is None or "/v1/result/" in uri or "data" in payload:
+            return payload
+        time.sleep(0.05)
+        payload, _ = _get(uri)
+    raise AssertionError("query did not finish in time")
+
+
+def _collect_pages(payload):
+    """Drain the nextUri chain; returns (rows, page_row_counts)."""
+    rows, counts = [], []
+    while True:
+        data = payload.get("data")
+        if data:
+            rows.extend(data)
+            counts.append(len(data))
+        uri = payload.get("nextUri")
+        if uri is None:
+            return rows, counts
+        payload, _ = _get(uri)
+
+
+def test_large_result_pages_and_reassembles(server):
+    srv, base = server
+    payload = _poll_until_done(base, _post(base, "SELECT a, b FROM t "
+                                                 "ORDER BY a"))
+    # the finishing /v1/status response is page 0 + a REAL nextUri
+    assert "/v1/result/" in payload["nextUri"]
+    assert len(payload["data"]) == PAGE
+    rows, counts = _collect_pages(payload)
+    assert len(rows) == ROWS
+    assert rows[0] == [0, 0.0]
+    assert rows[-1] == [ROWS - 1, (ROWS - 1) * 2.0]
+    # no single response carried more than one page of rows
+    assert max(counts) <= PAGE
+    # every page freed as fetched: nothing left in the store or registry
+    assert spill_mod.get_store().stats()["runs"] == 0
+    assert not srv.app_state.spools
+    assert not srv.app_state.future_list
+    assert not srv.app_state.query_info
+    assert tel.REGISTRY.get("result_spooled") >= 1
+    assert tel.REGISTRY.get("result_pages_served") >= len(counts)
+
+
+def test_status_repoll_and_page_replay_semantics(server):
+    srv, base = server
+    payload = _poll_until_done(base, _post(base, "SELECT a FROM t"))
+    uid = payload["id"]
+    first = payload["nextUri"]
+    # a /v1/status re-poll after page 0: FINISHED, columns, nextUri to
+    # the lowest uncollected page, and NO data (rows travel once)
+    repoll, _ = _get(f"{base}/v1/status/{uid}")
+    assert repoll["stats"]["state"] == "FINISHED"
+    assert repoll["columns"]
+    assert "data" not in repoll
+    assert repoll["nextUri"].endswith("/1")
+    # page 1 can be re-fetched (network-retry) until page 2 is taken
+    p1a, _ = _get(first)
+    p1b, _ = _get(first)
+    assert p1a["data"] == p1b["data"]
+    p2, _ = _get(p1a["nextUri"])
+    assert p2["data"]
+    # now page 1 is freed: 410 Gone, typed
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(first)
+    assert ei.value.code == 410
+    # cancel mid-page drops the spool and frees every remaining page
+    req = urllib.request.Request(f"{base}/v1/cancel/{uid}",
+                                 method="DELETE")
+    urllib.request.urlopen(req).close()
+    assert spill_mod.get_store().stats()["runs"] == 0
+    assert uid not in srv.app_state.spools
+
+
+def test_reaper_collects_abandoned_spool_and_future(server, monkeypatch):
+    srv, base = server
+    state = srv.app_state
+    # (1) a spooled result the client walks away from mid-pagination
+    payload = _poll_until_done(base, _post(base, "SELECT a FROM t"))
+    uid_spool = payload["id"]
+    assert uid_spool in state.spools
+    # (2) a finished query whose result is never collected — the
+    # historical future_list/query_info/seats leak
+    submitted = _post(base, "SELECT COUNT(*) AS n FROM t")
+    uid_leak = submitted["id"]
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        fut = state.future_list.get(uid_leak)
+        if fut is not None and fut.done():
+            break
+        time.sleep(0.05)
+    assert state.future_list[uid_leak].done()
+    reaped0 = tel.REGISTRY.get("result_reaped")
+    # TTL=0 disables reaping entirely
+    monkeypatch.setenv("DSQL_RESULT_TTL_S", "0")
+    assert state.reap_once(now=time.monotonic() + 10_000) == 0
+    # a tick far past the TTL reaps both
+    monkeypatch.setenv("DSQL_RESULT_TTL_S", "60")
+    n = state.reap_once(now=time.monotonic() + 120)
+    assert n >= 2
+    assert uid_spool not in state.spools
+    assert uid_leak not in state.future_list
+    assert uid_leak not in state.query_info
+    assert uid_leak not in state.seats
+    assert spill_mod.get_store().stats()["runs"] == 0
+    assert tel.REGISTRY.get("result_reaped") - reaped0 >= 2
+    # the reaped entries no longer occupy /v1/engine
+    eng, _ = _get(f"{base}/v1/engine")
+    assert eng["serverQueries"] == []
+    # a reaped result id answers 404, typed
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"{base}/v1/status/{uid_leak}")
+    assert ei.value.code == 404
+
+
+def test_result_spool_fault_degrades_to_unpaged(server):
+    _, base = server
+    with faults.inject("result_spool:1"):
+        payload = _poll_until_done(base, _post(base, "SELECT a FROM t"))
+        # the spool path faulted: the classic single-shot payload, whole
+        # result inline, no /v1/result nextUri — degraded, never broken
+        assert "nextUri" not in payload
+        assert len(payload["data"]) == ROWS
+    assert tel.REGISTRY.get("fault_result_spool") >= 1
+    assert spill_mod.get_store().stats()["runs"] == 0
+
+
+def test_small_results_never_spool(server):
+    _, base = server
+    payload = _poll_until_done(base, _post(base,
+                                           "SELECT COUNT(*) AS n FROM t"))
+    assert "nextUri" not in payload
+    assert payload["data"] == [[ROWS]]
+    assert spill_mod.get_store().stats()["runs"] == 0
+
+
+def test_kill_switch_restores_single_shot_payload(server, monkeypatch):
+    """DSQL_RESULT_PAGE_ROWS=0: the exact pre-paging payload — same keys,
+    whole result inline, no spool, no /v1/result involvement."""
+    _, base = server
+    monkeypatch.setenv("DSQL_RESULT_PAGE_ROWS", "0")
+    payload = _poll_until_done(base, _post(base, "SELECT a, b FROM t "
+                                                 "ORDER BY a"))
+    assert sorted(payload.keys()) == ["columns", "data", "id", "infoUri",
+                                      "stats"]
+    assert len(payload["data"]) == ROWS
+    assert spill_mod.get_store().stats()["runs"] == 0

@@ -76,3 +76,70 @@ def test_retry_after_header_sources():
     # ServerDraining is an AdmissionRejected: anything handling the 429
     # family (seat release, retry hints) handles draining for free
     assert issubclass(R.ServerDraining, R.AdmissionRejected)
+
+
+@pytest.mark.parametrize("cls_name,error_name", [
+    ("TenantQuotaExceeded", "TENANT_QUOTA_EXCEEDED"),
+    ("TenantCircuitOpen", "TENANT_CIRCUIT_OPEN"),
+    ("LoadShedRejected", "SLO_LOAD_SHED"),
+])
+def test_new_429_family_rides_admission_rejected(cls_name, error_name):
+    """ISSUE 17's tenant-quota / circuit-breaker / load-shed verdicts are
+    AdmissionRejected subclasses: the whole 429 + Retry-After wire path
+    (submit_status, the server's reject closure, seat/grant release)
+    handles them with zero new plumbing — and each keeps its own audited
+    errorName so clients can key DISTINCT retry policy on them."""
+    cls = getattr(R, cls_name)
+    exc = cls("boom", retry_after_s=3.25)
+    assert isinstance(exc, R.AdmissionRejected)
+    assert app.submit_status(exc) == 429
+    assert exc.retry_after_s == 3.25
+    err = app._error_payload("boom", "uid-1", exc=exc)["error"]
+    assert err["errorType"] == "INSUFFICIENT_RESOURCES"
+    assert err["errorName"] == error_name
+    # classify() must pass the typed verdict through unchanged — a
+    # re-wrap would demote it to the parent's QUERY_QUEUE_FULL name
+    assert R.classify(exc) is exc
+
+
+def test_tenant_reject_wire_handshake_with_trace(monkeypatch):
+    """Wire-level: a tenant-quota 429 from a REAL server carries an
+    honest Retry-After header AND the X-DSQL-Trace correlation header
+    when the watchtower is armed (the reject closure merges both)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    import pandas as pd
+
+    monkeypatch.setenv("DSQL_EVENTS", "1")
+    monkeypatch.setenv("DSQL_TENANT_CONCURRENT", "1")
+    from dask_sql_tpu.context import Context
+    from dask_sql_tpu.runtime import tenancy
+    from dask_sql_tpu.server.app import run_server
+
+    tenancy.get_registry()._reset_for_tests()
+    context = Context()
+    context.create_table("df", pd.DataFrame({"a": [1, 2, 3]}))
+    srv = run_server(context=context, host="127.0.0.1", port=0,
+                     blocking=False)
+    try:
+        base = f"http://127.0.0.1:{srv.server_port}"
+        # hold the single concurrency slot open by claiming it directly
+        grant = tenancy.get_registry().claim("crowded")
+        req = urllib.request.Request(
+            f"{base}/v1/statement", data=b"SELECT 1 + 1", method="POST",
+            headers={"X-DSQL-Tenant": "crowded",
+                     "X-DSQL-Trace": "trace-xyz"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert ei.value.headers["X-DSQL-Trace"] == "trace-xyz"
+        err = json.loads(ei.value.read())["error"]
+        assert err["errorName"] == "TENANT_QUOTA_EXCEEDED"
+        assert err["errorType"] == "INSUFFICIENT_RESOURCES"
+        tenancy.get_registry().release(grant)
+    finally:
+        srv.shutdown()
+        tenancy.get_registry()._reset_for_tests()

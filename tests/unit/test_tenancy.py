@@ -1,0 +1,276 @@
+"""Unit tests for runtime/tenancy.py: identity sanitation, token-bucket
+rate quota, concurrency quota, the per-tenant circuit breaker's
+open/half-open/closed lifecycle, and the admission() scope's exactly-once
+grant consumption + outcome classification."""
+import pytest
+
+from dask_sql_tpu.runtime import resilience as R
+from dask_sql_tpu.runtime import tenancy
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    tenancy.get_registry()._reset_for_tests()
+    yield
+    tenancy.get_registry()._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# identity
+# ---------------------------------------------------------------------------
+
+def test_sanitize_tenant_charset():
+    assert tenancy.sanitize_tenant("acme-corp_01") == "acme-corp_01"
+    # padding strips; the remainder is judged on its own
+    assert tenancy.sanitize_tenant("  ok  ") == "ok"
+    assert tenancy.sanitize_tenant("bad tenant") is None
+    assert tenancy.sanitize_tenant("a/b") is None
+    assert tenancy.sanitize_tenant("x" * 65) is None
+    assert tenancy.sanitize_tenant("x" * 64) == "x" * 64
+    assert tenancy.sanitize_tenant(None) is None
+    assert tenancy.sanitize_tenant("") is None
+
+
+def test_invalid_header_maps_to_default_tenant():
+    g = tenancy.get_registry().claim("not a valid tenant!!")
+    assert g.tenant == tenancy.DEFAULT_TENANT
+    tenancy.get_registry().release(g)
+
+
+def test_tenant_scope_rejects_garbage_loudly():
+    with pytest.raises(ValueError):
+        with tenancy.tenant_scope("no spaces allowed"):
+            pass
+    with tenancy.tenant_scope("fine-name"):
+        assert tenancy.current_tenant() == "fine-name"
+    assert tenancy.current_tenant() is None
+
+
+# ---------------------------------------------------------------------------
+# quotas
+# ---------------------------------------------------------------------------
+
+def test_unlimited_by_default():
+    reg = tenancy.get_registry()
+    grants = [reg.claim("t") for _ in range(50)]
+    for g in grants:
+        reg.release(g, "ok")
+    rows = tenancy.tenant_rows()
+    assert rows[0]["admitted"] == 50
+    assert rows[0]["inflight"] == 0
+
+
+def test_rate_quota_rejects_with_honest_retry_after(monkeypatch):
+    monkeypatch.setenv("DSQL_TENANT_QPS", "2")
+    reg = tenancy.get_registry()
+    # burst = one second of tokens (2): the third claim in the same
+    # instant must be over quota
+    reg.release(reg.claim("r"), "ok")
+    reg.release(reg.claim("r"), "ok")
+    with pytest.raises(R.TenantQuotaExceeded) as ei:
+        reg.claim("r")
+    # the refill pace is 2 tokens/s -> a sub-second, non-zero hint
+    assert 0.0 < ei.value.retry_after_s <= 0.5
+    assert tenancy.tenant_rows()[0]["quota_rejects"] == 1
+
+
+def test_rate_quota_is_per_tenant(monkeypatch):
+    monkeypatch.setenv("DSQL_TENANT_QPS", "1")
+    reg = tenancy.get_registry()
+    reg.release(reg.claim("a"), "ok")
+    with pytest.raises(R.TenantQuotaExceeded):
+        reg.claim("a")
+    # tenant b still has its own full bucket
+    reg.release(reg.claim("b"), "ok")
+
+
+def test_concurrency_quota(monkeypatch):
+    monkeypatch.setenv("DSQL_TENANT_CONCURRENT", "2")
+    reg = tenancy.get_registry()
+    g1, g2 = reg.claim("c"), reg.claim("c")
+    with pytest.raises(R.TenantQuotaExceeded):
+        reg.claim("c")
+    reg.release(g1, "ok")
+    g3 = reg.claim("c")          # a released slot is claimable again
+    reg.release(g2, "ok")
+    reg.release(g3, "ok")
+    assert tenancy.tenant_rows()[0]["inflight"] == 0
+
+
+def test_release_is_idempotent():
+    reg = tenancy.get_registry()
+    g = reg.claim("i")
+    reg.release(g, "ok")
+    reg.release(g, "ok")
+    assert tenancy.tenant_rows()[0]["inflight"] == 0
+    assert tenancy.tenant_rows()[0]["completed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+def _fail_n(reg, tenant, n, outcome="fatal"):
+    for _ in range(n):
+        reg.release(reg.claim(tenant), outcome)
+
+
+def test_breaker_trips_on_consecutive_fatals(monkeypatch):
+    monkeypatch.setenv("DSQL_TENANT_BREAKER", "3")
+    monkeypatch.setenv("DSQL_TENANT_BREAKER_TTL_S", "30")
+    reg = tenancy.get_registry()
+    _fail_n(reg, "b", 3)
+    row = tenancy.tenant_rows()[0]
+    assert row["circuit"] == "open"
+    assert row["circuit_opens"] == 1
+    with pytest.raises(R.TenantCircuitOpen) as ei:
+        reg.claim("b")
+    assert ei.value.retry_after_s > 0
+    assert tenancy.tenant_rows()[0]["circuit_rejects"] == 1
+
+
+def test_breaker_needs_consecutive_failures(monkeypatch):
+    monkeypatch.setenv("DSQL_TENANT_BREAKER", "3")
+    reg = tenancy.get_registry()
+    _fail_n(reg, "b", 2)
+    reg.release(reg.claim("b"), "ok")      # streak broken
+    _fail_n(reg, "b", 2)
+    assert tenancy.tenant_rows()[0]["circuit"] == "closed"
+
+
+def test_user_errors_do_not_trip(monkeypatch):
+    monkeypatch.setenv("DSQL_TENANT_BREAKER", "2")
+    reg = tenancy.get_registry()
+    _fail_n(reg, "b", 5, outcome="error")
+    assert tenancy.tenant_rows()[0]["circuit"] == "closed"
+
+
+def test_breaker_half_open_single_probe_then_close(monkeypatch):
+    """After the TTL the breaker goes half-open on the quarantine
+    pattern: exactly ONE probe is admitted (concurrent claims keep
+    rejecting while it is in flight); a clean probe closes the circuit,
+    a failed one re-arms the full TTL."""
+    monkeypatch.setenv("DSQL_TENANT_BREAKER", "2")
+    monkeypatch.setenv("DSQL_TENANT_BREAKER_TTL_S", "0.1")
+    monkeypatch.setenv("DSQL_TENANT_BREAKER_PROBE_S", "30")
+    reg = tenancy.get_registry()
+    _fail_n(reg, "h", 2)
+    with pytest.raises(R.TenantCircuitOpen):
+        reg.claim("h")
+    import time
+    time.sleep(0.15)                       # TTL expires -> half-open
+    probe = reg.claim("h")                 # THE single probe
+    assert probe.probe
+    assert tenancy.tenant_rows()[0]["circuit"] == "half-open"
+    with pytest.raises(R.TenantCircuitOpen):
+        reg.claim("h")                     # probe in flight: still reject
+    reg.release(probe, "ok")               # clean probe closes the circuit
+    assert tenancy.tenant_rows()[0]["circuit"] == "closed"
+    reg.release(reg.claim("h"), "ok")      # traffic flows again
+
+
+def test_breaker_failed_probe_rearms(monkeypatch):
+    monkeypatch.setenv("DSQL_TENANT_BREAKER", "2")
+    monkeypatch.setenv("DSQL_TENANT_BREAKER_TTL_S", "0.1")
+    monkeypatch.setenv("DSQL_TENANT_BREAKER_PROBE_S", "30")
+    reg = tenancy.get_registry()
+    _fail_n(reg, "h", 2)
+    import time
+    time.sleep(0.15)
+    probe = reg.claim("h")
+    monkeypatch.setenv("DSQL_TENANT_BREAKER_TTL_S", "60")
+    reg.release(probe, "fatal")            # failed probe: full TTL again
+    row = tenancy.tenant_rows()[0]
+    assert row["circuit"] == "open"
+    assert row["circuit_opens"] == 2
+    with pytest.raises(R.TenantCircuitOpen):
+        reg.claim("h")
+
+
+def test_breaker_off_by_default():
+    reg = tenancy.get_registry()
+    _fail_n(reg, "never", 50)
+    assert tenancy.tenant_rows()[0]["circuit"] == "closed"
+
+
+# ---------------------------------------------------------------------------
+# admission() scope
+# ---------------------------------------------------------------------------
+
+def test_admission_consumes_server_preclaim_exactly_once(monkeypatch):
+    monkeypatch.setenv("DSQL_TENANT_QPS", "1")
+    reg = tenancy.get_registry()
+    grant = reg.claim("pre")               # spends the ONLY token
+    with tenancy.grant_scope(grant):
+        with tenancy.admission() as g:
+            assert g is grant
+            assert g.consumed
+    # the pre-claim was adopted, not re-claimed: no second token spent,
+    # and the grant was released with outcome "ok"
+    row = tenancy.tenant_rows()[0]
+    assert row["admitted"] == 1
+    assert row["completed"] == 1
+    assert row["inflight"] == 0
+
+
+def test_admission_classifies_outcomes(monkeypatch):
+    monkeypatch.setenv("DSQL_TENANT_BREAKER", "2")
+    reg = tenancy.get_registry()
+
+    def run(exc):
+        with tenancy.tenant_scope("o"):
+            with pytest.raises(type(exc)):
+                with tenancy.admission():
+                    raise exc
+
+    run(R.FatalError("boom"))
+    run(R.DeadlineExceeded("slow"))
+    assert tenancy.tenant_rows()[0]["circuit"] == "open"
+    reg._reset_for_tests()
+    # user errors never feed the breaker
+    run(ValueError("user"))
+    run(ValueError("user"))
+    run(ValueError("user"))
+    assert tenancy.tenant_rows()[0]["circuit"] == "closed"
+    assert tenancy.tenant_rows()[0]["failed"] == 3
+
+
+def test_admission_nested_rides_outer_claim():
+    with tenancy.tenant_scope("n"):
+        with tenancy.admission():
+            with tenancy.admission() as inner:
+                assert inner is None       # nested: pass-through
+    assert tenancy.tenant_rows()[0]["admitted"] == 1
+
+
+def test_unconsumed_grant_release_feeds_nothing(monkeypatch):
+    """A grant released without an outcome (DDL, pre-plan failure) frees
+    its concurrency slot but neither completes nor fails the tenant."""
+    monkeypatch.setenv("DSQL_TENANT_BREAKER", "1")
+    reg = tenancy.get_registry()
+    g = reg.claim("d")
+    reg.release(g)                         # no outcome
+    row = tenancy.tenant_rows()[0]
+    assert row["inflight"] == 0
+    assert row["completed"] == 0
+    assert row["circuit"] == "closed"
+
+
+def test_context_sql_tenant_stamps_report(monkeypatch):
+    """Context.sql(tenant=...) flows the tenant onto the QueryReport (and
+    from there the slow-query log / flight-recorder envelope); the
+    default tenant stays OFF every envelope."""
+    import pandas as pd
+
+    from dask_sql_tpu.context import Context
+
+    c = Context()
+    c.create_table("t", pd.DataFrame({"a": [1, 2, 3]}))
+    c.sql("SELECT SUM(a) AS s FROM t", tenant="acme")
+    assert c.last_report.tenant == "acme"
+    assert c.last_report.to_dict()["tenant"] == "acme"
+    c.sql("SELECT SUM(a) AS s FROM t")
+    assert c.last_report.tenant is None
+    rows = {r["tenant"]: r for r in tenancy.tenant_rows()}
+    assert rows["acme"]["admitted"] == 1
+    assert rows[tenancy.DEFAULT_TENANT]["admitted"] >= 1
