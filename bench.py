@@ -435,6 +435,157 @@ def _stage_main():
         sys.stderr.flush()
         os._exit(0)
 
+    if os.environ.get("BENCH_FLEET_CHILD") == "1":
+        # FLEET mode (parent opts in with BENCH_FLEET=1): two server
+        # REPLICAS on one shared DSQL_FLEET_DIR + a FRESH shared
+        # DSQL_PROGRAM_STORE, driven through a Zipf multi-tenant
+        # parameterized burst over the wire.  Journals per-tenant SLO
+        # attainment from the merged fleet plane, the fleet-wide
+        # plan-cache hit rate, and the cross-replica warm serves —
+        # replica B must answer shapes replica A compiled with ZERO
+        # compiles of its own.
+        import subprocess
+        import tempfile as _ftmp
+        import urllib.request as _furl
+
+        import numpy as _fnp
+
+        fleet_root = _ftmp.mkdtemp(prefix="bench_fleet_")
+        fleet_dir = os.path.join(fleet_root, "fleet")
+        store_dir = os.path.join(fleet_root, "programs")
+        os.makedirs(store_dir, exist_ok=True)
+        server_src = (
+            "import os, time\n"
+            "import pandas as pd\n"
+            "from dask_sql_tpu import Context\n"
+            "c = Context()\n"
+            "c.create_table('lineitem', pd.read_feather(os.path.join(\n"
+            "    os.environ['BENCH_DATA_DIR'], 'lineitem.feather')))\n"
+            "srv = c.run_server(host='127.0.0.1', port=0, blocking=False)\n"
+            "print(f'PORT {srv.server_port}', flush=True)\n"
+            "while True:\n"
+            "    time.sleep(0.5)\n"
+        )
+
+        def _fleet_spawn(rid):
+            # FRESH XLA cache: the pass proves warmth through the program
+            # store, and a bench-warmed shared DSQL_XLA_CACHE poisons it —
+            # serialize_executable on a cache-served CPU executable emits
+            # symbol references instead of embedded code, so the other
+            # replica's deserialize dies with "Symbols not found"
+            env = dict(os.environ, DSQL_FLEET_DIR=fleet_dir,
+                       DSQL_REPLICA_ID=rid, DSQL_FLEET_BEAT_S="0.2",
+                       DSQL_PROGRAM_STORE=store_dir,
+                       DSQL_XLA_CACHE=os.path.join(fleet_root, "xla"),
+                       DSQL_RESULT_CACHE_MB="0",
+                       DSQL_MAX_CONCURRENT_QUERIES="0",
+                       DSQL_TIERED="0")
+            # per-replica rings must come from the fleet arm, not the
+            # bench-wide history file every other pass shares
+            for k in ("DSQL_EVENTS", "DSQL_EVENTS_FILE",
+                      "DSQL_HISTORY_FILE", "BENCH_STAGE"):
+                env.pop(k, None)
+            p = subprocess.Popen([sys.executable, "-c", server_src],
+                                 env=env, stdout=subprocess.PIPE,
+                                 stderr=subprocess.PIPE)
+            line = p.stdout.readline().decode().strip()
+            if not line.startswith("PORT "):
+                p.kill()
+                raise RuntimeError(
+                    f"fleet replica {rid} died: "
+                    f"{p.stderr.read().decode()[-300:]}")
+            return p, f"http://127.0.0.1:{line.split()[1]}"
+
+        def _fleet_req(url, body=None, headers=None):
+            req = _furl.Request(
+                url, data=body.encode() if body is not None else None,
+                headers=headers or {})
+            with _furl.urlopen(req, timeout=120) as r:
+                return json.loads(r.read() or b"null")
+
+        def _fleet_run(base, sql_body, tenant):
+            payload = _fleet_req(
+                f"{base}/v1/statement", sql_body,
+                headers={"Content-Type": "application/json",
+                         "X-DSQL-Tenant": tenant,
+                         "X-DSQL-Priority": "interactive"})
+            while "nextUri" in payload:
+                payload = _fleet_req(payload["nextUri"])
+            return payload
+
+        def _fleet_metric(base, name):
+            with _furl.urlopen(f"{base}/metrics", timeout=60) as r:
+                for ln in r.read().decode().splitlines():
+                    if not ln.startswith("#") \
+                            and ln.split("{")[0].split(" ")[0] == name:
+                        return float(ln.rsplit(" ", 1)[1])
+            return 0.0
+
+        fleet_rec, procs = {}, []
+        try:
+            pa, base_a = _fleet_spawn("bench-a")
+            procs.append(pa)
+            pb, base_b = _fleet_spawn("bench-b")
+            procs.append(pb)
+            tpl = ("SELECT l_returnflag, SUM(l_extendedprice) AS s, "
+                   "COUNT(*) AS n FROM lineitem WHERE l_quantity > ? "
+                   "GROUP BY l_returnflag ORDER BY l_returnflag")
+            distinct = [float(v) for v in
+                        _fnp.linspace(1.0, 45.0, 12).round(2)]
+            # replica A pays the one compile for the shape...
+            _fleet_run(base_a, json.dumps(
+                {"sql": tpl, "params": [distinct[0]]}), "tenant-0")
+            rng = _fnp.random.RandomState(31)
+            lit_ranks = _fnp.clip(rng.zipf(1.2, size=48), 1,
+                                  len(distinct)) - 1
+            ten_ranks = _fnp.clip(rng.zipf(1.3, size=48), 1, 8) - 1
+            execs = 0
+            # ...then the Zipf mix lands on BOTH replicas: hot tenants,
+            # a literal long tail, every B-side execution warm-served
+            for i, (lr, tr) in enumerate(zip(lit_ranks, ten_ranks)):
+                if left() < 30:
+                    break
+                base = base_b if i % 2 else base_a
+                _fleet_run(base, json.dumps(
+                    {"sql": tpl, "params": [distinct[int(lr)]]}),
+                    f"tenant-{int(tr)}")
+                execs += 1
+            time.sleep(0.5)                 # let the final beats land
+            snap = _fleet_req(f"{base_a}/v1/fleet")
+            compiles_b = _fleet_metric(base_b, "dsql_compiles_total")
+            hits_b = _fleet_metric(base_b,
+                                   "dsql_program_store_hits_total")
+            plan_hits = sum(_fleet_metric(b, "dsql_param_plan_hits_total")
+                            for b in (base_a, base_b))
+            fleet_rec = {
+                "replicas": len(snap["replicas"]),
+                "alive": snap["totals"]["alive"],
+                "burst_executions": execs + 1,
+                "tenant_slo_attainment": snap["slo"].get("tenants") or None,
+                "plan_cache_hit_rate": round(
+                    plan_hits / max(execs + 1, 1), 3),
+                "warm_serves": snap["totals"]["warmServes"],
+                "replica_b_compiles": compiles_b,
+                "replica_b_store_hits": hits_b,
+                # the shared-warmth verdict: B executed half the burst
+                # without compiling anything
+                "cross_replica_warm": bool(compiles_b == 0 and hits_b > 0),
+            }
+        except Exception as e:
+            fleet_rec = {"error": repr(e)[:300]}
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        emit({"fleet": fleet_rec})
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
+
     # warmup = compilation; compiles overlap across threads (tracing holds
     # the GIL but the backend compile releases it), which matters on the
     # tunneled TPU where a single cold compile can take minutes.  Each
@@ -965,6 +1116,7 @@ def main():
         shard_scaling = None
         ooc_evidence = None
         mv_evidence = None
+        fleet_evidence = None
         load_sec = warmup_sec = 0.0
         try:
             with open(state["progress"]) as f:
@@ -1020,6 +1172,8 @@ def main():
                         ooc_evidence = rec["ooc"] or None
                     elif "mv" in rec:
                         mv_evidence = rec["mv"] or None
+                    elif "fleet" in rec:
+                        fleet_evidence = rec["fleet"] or None
                     elif "slo_attainment" in rec:
                         slo_att = rec["slo_attainment"] or None
                     elif "param_mix" in rec:
@@ -1095,6 +1249,13 @@ def main():
                 round(param_mix["param_plan_hits"]
                       / max(param_mix["executions"], 1), 3)
                 if param_mix else None),
+            # fleet plane (ISSUE 18, BENCH_FLEET=1): cross-replica warm
+            # serves off the shared program store and the fleet-wide
+            # plan-cache hit rate over the multi-replica Zipf burst;
+            # None when the fleet pass never ran
+            "fleet_warm_serves": (fleet_evidence or {}).get("warm_serves"),
+            "fleet_plan_cache_hit_rate":
+                (fleet_evidence or {}).get("plan_cache_hit_rate"),
         }
         if not done:
             out = {"metric": "tpch_q1_q22_geomean_wall", "value": -1,
@@ -1190,6 +1351,12 @@ def main():
                     # lineitem, with the mv refresh hit-rate and the
                     # served-vs-recomputed exactness verdict
                     "mv": mv_evidence,
+                    # fleet-plane evidence (runtime/fleet.py,
+                    # BENCH_FLEET=1): two replicas on one fleet dir +
+                    # program store under a Zipf multi-tenant burst —
+                    # per-tenant SLO attainment, replica B's zero-compile
+                    # warm serves, and the fleet plan-cache hit rate
+                    "fleet": fleet_evidence,
                     "program_store_hit_rate": (
                         round(restart_info["program_store_hits"]
                               / max(restart_info["program_store_hits"]
@@ -1588,6 +1755,32 @@ def main():
             proc.kill()
             proc.communicate()  # reap
             state["stage_meta"].append({"attempt": "mv",
+                                        "error": "timeout"})
+        finally:
+            state["child"] = None
+
+    # FLEET pass (opt-in: BENCH_FLEET=1): two server replicas on one
+    # shared DSQL_FLEET_DIR + fresh shared program store, a Zipf
+    # multi-tenant parameterized burst split across them — journals
+    # per-tenant SLO attainment off the merged fleet plane, the
+    # fleet-wide plan-cache hit rate, and the cross-replica warm-serve
+    # verdict (replica B answers A's shapes with zero compiles)
+    fleet_left = deadline - EMIT_MARGIN - time.monotonic()
+    if os.environ.get("BENCH_FLEET") == "1" and fleet_left > 60:
+        env = dict(env_base, BENCH_FLEET_CHILD="1",
+                   BENCH_STAGE_QUERIES="1",
+                   BENCH_CHILD_DEADLINE=str(time.time() + fleet_left - 10))
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        state["child"] = proc
+        try:
+            proc.communicate(timeout=fleet_left)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()  # reap
+            state["stage_meta"].append({"attempt": "fleet",
                                         "error": "timeout"})
         finally:
             state["child"] = None

@@ -78,6 +78,16 @@ class Context:
         # EXECUTE binds the stored AST with fresh values; system.prepared
         # lists entries (physical/rel/custom.py, runtime/system_tables.py)
         self._prepared: dict = {}
+        # fleet plane (runtime/fleet.py): arm once per process when a
+        # shared fleet dir is configured — env checked BEFORE the import
+        # so the unarmed path stays zero-import (the recorder/profiler
+        # discipline).  Idempotent: the second Context is a no-op.
+        if os.environ.get("DSQL_FLEET_DIR"):
+            try:
+                from .runtime import fleet as _fleet
+                _fleet.ensure_armed()
+            except Exception:
+                logger.debug("fleet arming failed", exc_info=True)
         # register default input plugins (reference context.py:113-119 order)
         for plugin in (DeviceTableInputPlugin(), PandasLikeInputPlugin(),
                        DictInputPlugin(), ArrowInputPlugin(), HiveInputPlugin(),

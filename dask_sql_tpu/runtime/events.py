@@ -287,7 +287,18 @@ def _read_file(path: str) -> List[dict]:
     return out
 
 
-_CORE_FIELDS = ("seq", "unix", "pid", "trace", "type")
+_CORE_FIELDS = ("seq", "unix", "pid", "trace", "type", "replica")
+
+
+def _fleet_replica() -> Optional[str]:
+    """This process's replica id when the fleet plane (runtime/fleet.py)
+    is armed, else None — env checked BEFORE the import, so an unarmed
+    process never loads the fleet module and unarmed events stay
+    byte-identical (no ``replica`` field at all)."""
+    if not os.environ.get("DSQL_FLEET_DIR"):
+        return None
+    from . import fleet as _fleet
+    return _fleet.replica_id()
 
 
 def publish(etype: str, **fields) -> Optional[dict]:
@@ -301,6 +312,9 @@ def publish(etype: str, **fields) -> Optional[dict]:
                                "pid": os.getpid(),
                                "trace": str(tid) if tid else "",
                                "type": str(etype)}
+        rid = _fleet_replica()
+        if rid:
+            rec["replica"] = rid
         for k, v in fields.items():
             if v is not None and k not in _CORE_FIELDS:
                 rec[k] = v
@@ -330,7 +344,7 @@ def events_rows(limit: int = 2000) -> List[dict]:
     rows: List[dict] = []
     for rec in recs[-max(int(limit), 1):]:
         extra = {k: v for k, v in rec.items() if k not in _CORE_FIELDS}
-        rows.append({
+        row = {
             "seq": int(rec.get("seq", 0) or 0),
             "unix": float(rec.get("unix", 0.0) or 0.0),
             "pid": int(rec.get("pid", 0) or 0),
@@ -339,7 +353,12 @@ def events_rows(limit: int = 2000) -> List[dict]:
             "detail": (json.dumps(extra, separators=(",", ":"),
                                   default=str, sort_keys=True)
                        if extra else ""),
-        })
+        }
+        # stamped only when a fleet replica published it — unarmed rows
+        # keep the historical key set
+        if rec.get("replica"):
+            row["replica"] = str(rec["replica"])
+        rows.append(row)
     return rows
 
 
@@ -611,19 +630,33 @@ _tenant_slo: Dict[str, List[int]] = {}
 _tenant_slo_lock = threading.Lock()
 
 
+def max_tenant_gauges() -> int:
+    """``DSQL_MAX_TENANT_GAUGES`` (default 64): distinct per-tenant SLO
+    gauges before overflow tenants fold into one ``_other`` series — a
+    hostile/bursty tenant-id space can no longer grow ``/metrics``
+    without bound."""
+    return max(_env_int("DSQL_MAX_TENANT_GAUGES", 64), 1)
+
+
 def observe_tenant(tenant: str, priority: Optional[str],
                    wall_ms: float) -> None:
     """Fold one completed query into the tenant's SLO attainment gauge,
-    judged against the query's own class objective."""
+    judged against the query's own class objective.  Cardinality is
+    bounded: once ``max_tenant_gauges()`` distinct tenants have a
+    series, every NEW tenant folds into the shared ``_other`` series
+    (existing tenants keep their own)."""
     cls = SloMonitor._class(priority)
     ok = float(wall_ms) <= objective_ms(cls)
+    key = str(tenant)
     with _tenant_slo_lock:
-        tot = _tenant_slo.setdefault(str(tenant), [0, 0])
+        if key not in _tenant_slo and len(_tenant_slo) >= max_tenant_gauges():
+            key = "_other"
+        tot = _tenant_slo.setdefault(key, [0, 0])
         tot[0] += 1
         if ok:
             tot[1] += 1
         total, good = tot
-    _tel.REGISTRY.set_gauge(f"slo_attainment_tenant_{tenant}",
+    _tel.REGISTRY.set_gauge(f"slo_attainment_tenant_{key}",
                             round(good / total, 6))
 
 

@@ -123,6 +123,16 @@ def stats_max_entries() -> int:
 _STATS = MtimeCachedJsonFile(stats_path)
 
 
+def _fleet_replica() -> Optional[str]:
+    """Replica id when the fleet plane (runtime/fleet.py) is armed, else
+    None — env checked BEFORE the import, so unarmed envelopes stay
+    byte-identical and the fleet module stays un-imported."""
+    if not os.environ.get("DSQL_FLEET_DIR"):
+        return None
+    from . import fleet as _fleet
+    return _fleet.replica_id()
+
+
 # ---------------------------------------------------------------------------
 # the JSONL ring
 # ---------------------------------------------------------------------------
@@ -388,6 +398,9 @@ def record_query(report, error: Optional[BaseException] = None) -> None:
     ten = getattr(report, "tenant", None)
     if ten:
         rec["tenant"] = str(ten)
+    rid = _fleet_replica()
+    if rid:
+        rec["replica"] = rid
     _append(path, rec)
     if plan_fp and error is None and measured > 0:
         _observe_stat(plan_fp, nbytes=measured, rows=report.rows_out,
@@ -416,6 +429,9 @@ def record_stage(digest: str, rows_in: int, rows_out: int, capacity: int,
         "wall_ms": round(float(wall_ms), 3),
         "device_ms": round(float(device_ms), 3) if device_ms else 0.0,
     }
+    rid = _fleet_replica()
+    if rid:
+        rec["replica"] = rid
     _append(path, rec)
     _observe_stat(digest, nbytes=nbytes, rows=rows_out, ms=wall_ms)
 

@@ -15,8 +15,11 @@ occupy result-cache budget or interact with catalog epochs):
 - ``system.quarantine``  standing compiler-crash verdicts
 - ``system.programs``    persistent program-store index
 - ``system.devices``     per-local-device HBM in-use/peak/limit
-- ``system.events``      watchtower event bus ring (DSQL_EVENTS armed)
+- ``system.events``      watchtower event bus ring (DSQL_EVENTS armed;
+                         all replicas' rings merged when DSQL_FLEET_DIR
+                         is armed, each row stamped with its replica)
 - ``system.slo``         per-class latency objectives + burn rates
+- ``system.replicas``    fleet heartbeat registry (DSQL_FLEET_DIR armed)
 
 Every table has a FIXED column schema with explicit dtypes so an empty
 engine still binds and executes ``SELECT * FROM system.queries`` — object
@@ -33,7 +36,17 @@ from ..table import Table
 TABLE_NAMES = ("queries", "active", "metrics", "cache", "quarantine",
                "programs", "table_stats", "mesh", "spill", "devices",
                "matviews", "view_candidates", "events", "slo", "prepared",
-               "tenants")
+               "tenants", "replicas")
+
+
+def _fleet_on() -> bool:
+    """Fleet-plane gate (runtime/fleet.py): env checked BEFORE any
+    import, like ``_events``/``_slo`` below — with ``DSQL_FLEET_DIR``
+    unset the module stays out of sys.modules and the fleet tables
+    yield their fixed empty schemas."""
+    import os
+
+    return bool(os.environ.get("DSQL_FLEET_DIR"))
 
 
 def _col(rows: List[dict], key: str, dtype, default):
@@ -53,8 +66,16 @@ def _col(rows: List[dict], key: str, dtype, default):
 def _queries() -> Table:
     from . import flight_recorder as _fr
 
-    rows = _fr.read_events(kind="query")
+    if _fleet_on():
+        # fleet mode: every replica's envelope ring merged in timestamp
+        # order, each row stamped with its replica (runtime/fleet.py)
+        from . import fleet as _fleet
+
+        rows = _fleet.merged_query_rows()
+    else:
+        rows = _fr.read_events(kind="query")
     return Table.from_pydict({
+        "replica": _col(rows, "replica", object, ""),
         "unix": _col(rows, "unix", np.float64, 0.0),
         "pid": _col(rows, "pid", np.int64, 0),
         "query": _col(rows, "query", object, ""),
@@ -391,7 +412,13 @@ def _events() -> Table:
     import os
 
     rows: List[dict] = []
-    if os.environ.get("DSQL_EVENTS", "0").strip() not in ("", "0"):
+    if _fleet_on():
+        # fleet mode: all replicas' event rings merged in timestamp
+        # order — one trace id stitches across the replicas it touched
+        from . import fleet as _fleet
+
+        rows = _fleet.merged_events_rows()
+    elif os.environ.get("DSQL_EVENTS", "0").strip() not in ("", "0"):
         from . import events as _ev
 
         rows = _ev.events_rows()
@@ -401,6 +428,7 @@ def _events() -> Table:
         "pid": _col(rows, "pid", np.int64, 0),
         "trace": _col(rows, "trace", object, ""),
         "type": _col(rows, "type", object, ""),
+        "replica": _col(rows, "replica", object, ""),
         "detail": _col(rows, "detail", object, ""),
     })
 
@@ -460,6 +488,41 @@ def _tenants() -> Table:
     })
 
 
+def _replicas() -> Table:
+    """One row per registered fleet replica (runtime/fleet.py heartbeat
+    registry): identity, liveness (``alive`` = beat within TTL),
+    scheduler/cache/spill occupancy, and the shared-warmth counters
+    (program-store hits/misses/hit-rate per replica).  Same
+    env-gate-before-import discipline as ``system.events`` — an unset
+    ``DSQL_FLEET_DIR`` yields the fixed empty schema."""
+    rows: List[dict] = []
+    if _fleet_on():
+        from . import fleet as _fleet
+
+        rows = _fleet.replica_rows()
+    return Table.from_pydict({
+        "replica": _col(rows, "replica", object, ""),
+        "pid": _col(rows, "pid", np.int64, 0),
+        "host": _col(rows, "host", object, ""),
+        "alive": _col(rows, "alive", np.bool_, False),
+        "started": _col(rows, "started", np.float64, 0.0),
+        "beat": _col(rows, "beat", np.float64, 0.0),
+        "age_s": _col(rows, "age_s", np.float64, 0.0),
+        "running": _col(rows, "running", np.int64, 0),
+        "queue_depth": _col(rows, "queue_depth", np.int64, 0),
+        "slots": _col(rows, "slots", np.int64, 0),
+        "queries": _col(rows, "queries", np.int64, 0),
+        "cache_bytes": _col(rows, "cache_bytes", np.int64, 0),
+        "spill_bytes": _col(rows, "spill_bytes", np.int64, 0),
+        "reserved_bytes": _col(rows, "reserved_bytes", np.int64, 0),
+        "program_entries": _col(rows, "program_entries", np.int64, 0),
+        "program_hits": _col(rows, "program_hits", np.int64, 0),
+        "program_misses": _col(rows, "program_misses", np.int64, 0),
+        "program_hit_rate": _col(rows, "program_hit_rate", np.float64, 0.0),
+        "compiles": _col(rows, "compiles", np.int64, 0),
+    })
+
+
 _BUILDERS: Dict[str, object] = {
     "queries": _queries,
     "active": _active,
@@ -477,6 +540,7 @@ _BUILDERS: Dict[str, object] = {
     "slo": _slo,
     "prepared": _prepared,
     "tenants": _tenants,
+    "replicas": _replicas,
 }
 
 #: builders that need the resolving context (catalog / mesh live there)

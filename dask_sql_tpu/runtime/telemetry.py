@@ -185,6 +185,10 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     # sched_rejected_background, so the admission reconciliation
     # invariant admitted + rejected + timeout == submitted still holds)
     "sched_shed_background",
+    # fleet plane (runtime/fleet.py, DSQL_FLEET_DIR): heartbeat files
+    # written / beat failures swallowed, and merged-ring reads served
+    # (system.events fleet mode, /v1/events?fleet=1, /v1/fleet)
+    "fleet_heartbeats", "fleet_heartbeat_errors", "fleet_merged_reads",
 )
 
 STABLE_HISTOGRAMS: Tuple[str, ...] = (
@@ -224,6 +228,10 @@ STABLE_GAUGES: Tuple[str, ...] = (
     "slo_shedding",
     # tenants the registry has seen this process (runtime/tenancy.py)
     "tenants_known",
+    # fleet plane (runtime/fleet.py): replicas within heartbeat TTL at
+    # the last fleet snapshot, and the fleet-wide sum of every alive
+    # replica's program_store_hits — the shared-warmth proof counter
+    "fleet_replicas_alive", "fleet_warm_serves",
 )
 
 # exponential-ish bucket bounds in milliseconds; histograms are BOUNDED by
@@ -334,27 +342,40 @@ class MetricsRegistry:
             self._hists.clear()
 
     # -- prometheus --------------------------------------------------------
-    def render_prometheus(self) -> str:
+    def render_prometheus(self,
+                          labels: Optional[Dict[str, str]] = None) -> str:
         """Prometheus text exposition (text/plain; version=0.0.4).
 
         Counter ``k`` -> ``dsql_<k>_total``; histogram ``h`` ->
         ``dsql_<h>`` with le-bucketed ``_bucket`` series + ``_sum`` +
         ``_count``.  Names are sanitized to the prometheus charset.
+        ``labels`` (e.g. ``{"replica": "r1"}`` when a fleet dir is
+        armed) are stamped on EVERY series; with none the exposition is
+        byte-identical to the label-free historical format.
         """
         def clean(name: str) -> str:
             return "".join(c if (c.isalnum() or c == "_") else "_"
                            for c in name)
+
+        base = ""
+        if labels:
+            base = ",".join(f'{clean(k)}="{v}"'
+                            for k, v in sorted(labels.items()))
+
+        def series(m: str, extra: str = "") -> str:
+            parts = ",".join(p for p in (base, extra) if p)
+            return f"{m}{{{parts}}}" if parts else m
 
         snap = self.snapshot()
         out: List[str] = []
         for k in sorted(snap["counters"]):
             m = f"dsql_{clean(k)}_total"
             out.append(f"# TYPE {m} counter")
-            out.append(f"{m} {snap['counters'][k]}")
+            out.append(f"{series(m)} {snap['counters'][k]}")
         for k in sorted(snap.get("gauges", ())):
             m = f"dsql_{clean(k)}"
             out.append(f"# TYPE {m} gauge")
-            out.append(f"{m} {snap['gauges'][k]:g}")
+            out.append(f"{series(m)} {snap['gauges'][k]:g}")
         for k in sorted(snap["histograms"]):
             h = snap["histograms"][k]
             m = f"dsql_{clean(k)}"
@@ -362,11 +383,13 @@ class MetricsRegistry:
             acc = 0
             for bound, c in h["buckets"]:
                 acc += c
-                out.append(f'{m}_bucket{{le="{bound:g}"}} {acc}')
+                le = 'le="%g"' % bound
+                out.append(f"{series(m + '_bucket', le)} {acc}")
             acc += h["overflow"]
-            out.append(f'{m}_bucket{{le="+Inf"}} {acc}')
-            out.append(f"{m}_sum {h['sum']:.6g}")
-            out.append(f"{m}_count {h['count']}")
+            inf = 'le="+Inf"'
+            out.append(f"{series(m + '_bucket', inf)} {acc}")
+            out.append(f"{series(m + '_sum')} {h['sum']:.6g}")
+            out.append(f"{series(m + '_count')} {h['count']}")
         return "\n".join(out) + "\n"
 
 
@@ -558,6 +581,19 @@ def record_nodes():
 # reports
 # ---------------------------------------------------------------------------
 
+def _fleet_replica() -> Optional[str]:
+    """Replica id when the fleet plane (runtime/fleet.py) is armed, else
+    None — env checked BEFORE the import (the profiler/recorder gate
+    discipline), so the unarmed path costs one dict lookup."""
+    if not os.environ.get("DSQL_FLEET_DIR"):
+        return None
+    try:
+        from . import fleet as _fleet
+        return _fleet.replica_id()
+    except Exception:
+        return None
+
+
 # span names that aggregate into the phase breakdown; "device"/"materialize"
 # values may also arrive as span ATTRS (device_ms) when DSQL_TIME_DEVICE
 # splits the execute wall
@@ -579,7 +615,8 @@ class QueryReport:
     __slots__ = ("query", "wall_ms", "phases", "counters", "root",
                  "rows_out", "bytes_out", "started_unix", "cache", "tier",
                  "priority", "operators", "spilled", "skew_ratio",
-                 "collective_bytes", "cost_err", "trace_id", "tenant")
+                 "collective_bytes", "cost_err", "trace_id", "tenant",
+                 "replica")
 
     def __init__(self, trace: QueryTrace):
         root = trace.root
@@ -597,6 +634,10 @@ class QueryReport:
         # emit it only when present, like the trace ID
         ten = root.attrs.get("tenant")
         self.tenant = str(ten) if ten else None
+        # replica identity (runtime/fleet.py): present only when a fleet
+        # dir is armed — env checked before the import so single-process
+        # reports stay byte-identical and the fleet module un-imported
+        self.replica = _fleet_replica()
         self.rows_out = int(root.attrs.get("rows_out", 0))
         self.bytes_out = int(root.attrs.get("bytes_out", 0))
         phases: Dict[str, float] = {}
@@ -702,21 +743,25 @@ class QueryReport:
         return sum(1 for s in self.root.walk() if s.name == name)
 
     def to_dict(self) -> dict:
-        return {"query": self.query, "wall_ms": round(self.wall_ms, 3),
-                "trace_id": self.trace_id,
-                "tenant": self.tenant,
-                "phases": {k: round(v, 3) for k, v in self.phases.items()},
-                "counters": dict(self.counters),
-                "cache": dict(self.cache),
-                "tier": self.tier,
-                "priority": self.priority,
-                "operators": list(self.operators),
-                "spilled": self.spilled,
-                "skew_ratio": self.skew_ratio,
-                "collective_bytes": self.collective_bytes,
-                "cost_err": self.cost_err,
-                "rows_out": self.rows_out, "bytes_out": self.bytes_out,
-                "spans": self.root.to_dict()}
+        out = {"query": self.query, "wall_ms": round(self.wall_ms, 3),
+               "trace_id": self.trace_id,
+               "tenant": self.tenant,
+               "phases": {k: round(v, 3) for k, v in self.phases.items()},
+               "counters": dict(self.counters),
+               "cache": dict(self.cache),
+               "tier": self.tier,
+               "priority": self.priority,
+               "operators": list(self.operators),
+               "spilled": self.spilled,
+               "skew_ratio": self.skew_ratio,
+               "collective_bytes": self.collective_bytes,
+               "cost_err": self.cost_err,
+               "rows_out": self.rows_out, "bytes_out": self.bytes_out,
+               "spans": self.root.to_dict()}
+        # fleet-armed only, so the unarmed dict stays key-identical
+        if self.replica:
+            out["replica"] = self.replica
+        return out
 
     def render(self) -> str:
         """Human-readable report: header + indented span tree."""
@@ -770,6 +815,8 @@ class QueryReport:
         other = {"query": self.query[:500]}
         if self.trace_id:
             other["trace_id"] = self.trace_id
+        if self.replica:
+            other["replica"] = self.replica
         return {"traceEvents": events,
                 "displayTimeUnit": "ms",
                 "otherData": other}
@@ -846,7 +893,7 @@ def _close_trace(trace: QueryTrace, error: Optional[BaseException]) -> None:
         logger.warning(
             "slow query (%.0f ms >= DSQL_SLOW_QUERY_MS=%.0f): %s | tier: %s "
             "| cacheHit: %s | priority: %s | skew: %s | collectives: %s "
-            "| costErr: %s | phases: %s | counters: %s%s%s",
+            "| costErr: %s | phases: %s | counters: %s%s%s%s",
             report.wall_ms, slow_ms, report.query.strip()[:500],
             report.tier or "eager", bool(report.cache.get("hit")),
             report.priority or "-",
@@ -855,10 +902,11 @@ def _close_trace(trace: QueryTrace, error: Optional[BaseException]) -> None:
             report.cost_err if report.cost_err is not None else "-",
             {k: round(v, 1) for k, v in sorted(report.phases.items())},
             dict(sorted(report.counters.items())),
-            # trace/tenant correlation suffixes only when they exist, so
-            # the line stays byte-identical with the features off
+            # trace/tenant/replica correlation suffixes only when they
+            # exist, so the line stays byte-identical with the features off
             f" | trace: {report.trace_id}" if report.trace_id else "",
-            f" | tenant: {report.tenant}" if report.tenant else "")
+            f" | tenant: {report.tenant}" if report.tenant else "",
+            f" | replica: {report.replica}" if report.replica else "")
 
     _export_chrome_trace(report)
 

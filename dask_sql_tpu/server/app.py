@@ -101,6 +101,13 @@ def _tenancy_on() -> bool:
     return os.environ.get("DSQL_TENANCY", "1").strip() not in ("", "0")
 
 
+def _fleet_on() -> bool:
+    """Fleet-plane gate (runtime/fleet.py): checked BEFORE any import so
+    an unset DSQL_FLEET_DIR keeps the module un-imported, /v1/fleet on
+    the generic 404, and every wire byte byte-identical."""
+    return bool(os.environ.get("DSQL_FLEET_DIR"))
+
+
 def _page_rows() -> int:
     """Result-paging threshold (``DSQL_RESULT_PAGE_ROWS``): results with
     more rows spool into SpillStore pages of this many rows; 0 restores
@@ -559,6 +566,10 @@ def _engine_snapshot(state: "_AppState") -> dict:
     if _tenancy_on():
         from ..runtime import tenancy as _ten
         out["tenants"] = _ten.get_registry().snapshot()
+    if _fleet_on():
+        from ..runtime import fleet as _fleet
+        out["fleet"] = {"replica": _fleet.replica_id(),
+                        "dir": _fleet.fleet_dir() or ""}
     return out
 
 
@@ -909,8 +920,14 @@ def _make_handler(state: _AppState, base_url: str):
             if self.path.rstrip("/").split("?")[0] == "/metrics":
                 # Prometheus text exposition of the engine's telemetry
                 # registry: the same counters previously only reachable
-                # in-process via physical.compiled.stats
-                body = _tel.REGISTRY.render_prometheus().encode()
+                # in-process via physical.compiled.stats.  With a fleet
+                # dir armed every series carries a replica label, so a
+                # scraper summing across replicas never mixes series
+                labels = None
+                if _fleet_on():
+                    from ..runtime import fleet as _fleet
+                    labels = {"replica": _fleet.replica_id()}
+                body = _tel.REGISTRY.render_prometheus(labels).encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4; charset=utf-8")
@@ -924,6 +941,21 @@ def _make_handler(state: _AppState, base_url: str):
                 except Exception:
                     logger.exception("/v1/engine snapshot failed")
                     self._send(500, {"error": "snapshot failed"})
+                    return
+                self._send(200, payload)
+                return
+            if (self.path.rstrip("/").split("?")[0] == "/v1/fleet"
+                    and _fleet_on()):
+                # the aggregated fleet snapshot (runtime/fleet.py):
+                # per-replica heartbeat rows + fleet-wide sums + merged
+                # SLO + promoted anomalies.  Unset fleet dir falls
+                # through to the generic 404 — byte-identical wire.
+                try:
+                    from ..runtime import fleet as _fleet
+                    payload = _fleet.snapshot()
+                except Exception:
+                    logger.exception("/v1/fleet snapshot failed")
+                    self._send(500, {"error": "fleet snapshot failed"})
                     return
                 self._send(200, payload)
                 return
@@ -1079,7 +1111,13 @@ def _make_handler(state: _AppState, base_url: str):
             delimited JSON events with ``seq > cursor``; the next cursor
             travels in ``X-DSQL-Cursor`` (and on each event's ``seq``).
             A draining process answers immediately with whatever is
-            buffered instead of holding the long-poll open."""
+            buffered instead of holding the long-poll open.
+
+            ``?fleet=1`` (fleet dir armed) switches to the MERGED
+            cross-replica stream (runtime/fleet.py): events from every
+            replica's ring k-way-merged in timestamp order, cursored by
+            the composite ``replica:seq;...`` string instead of one
+            integer."""
             from urllib.parse import parse_qs, urlparse
             from ..runtime import events as _ev
 
@@ -1091,13 +1129,21 @@ def _make_handler(state: _AppState, base_url: str):
                 except (ValueError, TypeError, IndexError):
                     return default
 
-            cursor = max(qint("cursor", 0), 0)
             limit = min(max(qint("limit", 500), 1), 5000)
             timeout_s = min(max(qint("timeout_ms", 0), 0) / 1e3, 30.0)
             if _sched.get_manager().draining():
                 timeout_s = 0.0
-            evs, nxt = _ev.read_since(cursor, limit=limit,
-                                      timeout_s=timeout_s)
+            fleet_mode = (q.get("fleet", ["0"])[0] not in ("", "0")
+                          and _fleet_on())
+            if fleet_mode:
+                from ..runtime import fleet as _fleet
+                raw_cursor = q.get("cursor", [""])[0]
+                evs, nxt = _fleet.read_merged_since(
+                    raw_cursor, limit=limit, timeout_s=timeout_s)
+            else:
+                cursor = max(qint("cursor", 0), 0)
+                evs, nxt = _ev.read_since(cursor, limit=limit,
+                                          timeout_s=timeout_s)
             body = b"".join(
                 json.dumps(e, separators=(",", ":"), default=str).encode()
                 + b"\n" for e in evs)
@@ -1316,6 +1362,12 @@ def run_server(context=None, host: str = "0.0.0.0", port: int = 8080,
         logging.basicConfig(level=log_level)
     from ..context import Context
 
+    # fleet plane: arm before serving so the heartbeat registers this
+    # replica even when an embedder passed a pre-built context (the
+    # Context.__init__ hook is idempotent with this one)
+    if _fleet_on():
+        from ..runtime import fleet as _fleet
+        _fleet.ensure_armed()
     context = context or Context()
     if startup:
         context.sql("SELECT 1 + 1")

@@ -129,6 +129,15 @@ echo "=== [2p] fleet smoke (result paging + tenant quotas + kill switches) ==="
 # DSQL_RESULT_PAGE_ROWS=0 / DSQL_TENANCY=0 must restore the pre-armor wire
 python scripts/fleet_smoke.py
 
+echo "=== [2q] fleet obs smoke (replica registry + shared warmth) ==="
+# two real server replicas on one shared DSQL_FLEET_DIR + program store:
+# replica B must serve replica A's query shape with ZERO compiles,
+# /v1/fleet must reconcile with each replica's own /v1/engine + /metrics,
+# one trace ID must stitch across both replicas in the merged
+# system.events stream, and unsetting DSQL_FLEET_DIR must restore the
+# label-free baseline wire exactly (fleet module never imported)
+python scripts/fleet_obs_smoke.py
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \

@@ -1,0 +1,284 @@
+#!/usr/bin/env python
+"""Fleet-plane smoke gate: replica registry, cross-replica aggregation,
+and the shared-warmth proof.
+
+Run by scripts/ci_local.sh (mirroring scripts/events_smoke.py):
+
+    python scripts/fleet_obs_smoke.py
+
+With two REAL server children on one shared ``DSQL_FLEET_DIR`` +
+``DSQL_PROGRAM_STORE`` the gate proves
+
+  1. both replicas register live heartbeats and ``GET /v1/fleet`` (asked
+     of either replica) reconciles with each replica's own
+     ``GET /v1/engine`` — pids match, fleet totals equal the sum of the
+     per-replica counters;
+  2. shared warmth: replica A compiles a query shape and persists the
+     programs; replica B then serves the SAME shape with ZERO XLA
+     compiles (``dsql_compiles_total == 0`` on B's /metrics,
+     ``program_store_hits > 0``) and an identical answer;
+  3. one trace ID stitches across replicas: the merged
+     ``system.events`` stream carries ``fleet-smoke-trace`` events
+     stamped with BOTH replica ids, in global timestamp order;
+  4. every /metrics series carries the ``replica`` label while armed;
+  5. unset ``DSQL_FLEET_DIR`` restores the baseline exactly: a child
+     with no fleet env never imports ``runtime.fleet``, serves the
+     generic 404 on ``/v1/fleet``, exposes label-free /metrics, and
+     returns bit-identical query results.
+
+Exit 0 on success.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_TMP = tempfile.mkdtemp(prefix="dsql_fleet_obs_")
+_FLEET_DIR = os.path.join(_TMP, "fleet")
+_STORE_DIR = os.path.join(_TMP, "store")
+os.makedirs(_STORE_DIR, exist_ok=True)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+QUERY = "SELECT k, SUM(v) AS s, COUNT(*) AS n FROM t GROUP BY k ORDER BY k"
+
+# each replica: identical table shape, a server, then park
+_CHILD = """
+import os, time
+import numpy as np
+from dask_sql_tpu import Context
+c = Context()
+n = 4096
+c.create_table("t", {"k": (np.arange(n, dtype=np.int64) % 32),
+                     "v": np.arange(n, dtype=np.float64)})
+srv = c.run_server(host="127.0.0.1", port=0, blocking=False)
+print(f"PORT {srv.server_port}", flush=True)
+while True:
+    time.sleep(0.5)
+"""
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _spawn_replica(rid: str):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("DSQL_")}
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "DSQL_FLEET_DIR": _FLEET_DIR,
+        "DSQL_REPLICA_ID": rid,
+        "DSQL_FLEET_BEAT_S": "0.2",
+        "DSQL_PROGRAM_STORE": _STORE_DIR,
+        "DSQL_RESULT_CACHE_MB": "0",
+        "DSQL_MAX_CONCURRENT_QUERIES": "0",
+        "DSQL_ADAPTIVE": "0",
+        "DSQL_TIERED": "0",
+    })
+    proc = subprocess.Popen([sys.executable, "-c", _CHILD], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+    line = proc.stdout.readline().decode().strip()
+    if not line.startswith("PORT "):
+        proc.kill()
+        raise RuntimeError(f"replica {rid} failed to start: {line!r} "
+                           f"{proc.stderr.read().decode()[-500:]}")
+    return proc, f"http://127.0.0.1:{line.split()[1]}"
+
+
+def _req(url, body=None, headers=None):
+    req = urllib.request.Request(
+        url, data=body.encode() if body is not None else None,
+        headers=headers or {})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read() or b"null"), dict(r.headers)
+
+
+def _run_query(base, sql, trace):
+    payload, _ = _req(f"{base}/v1/statement", sql,
+                      headers={"X-DSQL-Trace": trace})
+    while "nextUri" in payload:
+        payload, _ = _req(payload["nextUri"])
+    return payload
+
+
+def _metric(base, name):
+    """One counter value off /metrics, label-blind."""
+    with urllib.request.urlopen(f"{base}/metrics", timeout=60) as r:
+        for line in r.read().decode().splitlines():
+            if line.startswith("#"):
+                continue
+            key = line.split("{")[0].split(" ")[0]
+            if key == name:
+                return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+def main() -> int:
+    os.environ["DSQL_FLEET_DIR"] = _FLEET_DIR   # parent reads, read-only
+    from dask_sql_tpu.runtime import fleet
+
+    proc_a = proc_b = None
+    try:
+        proc_a, base_a = _spawn_replica("r-a")
+        proc_b, base_b = _spawn_replica("r-b")
+        print(f"ok spawn: r-a at {base_a}, r-b at {base_b}")
+
+        # -- 1. warmth: A compiles, B serves the same shape warm ----------
+        res_a = _run_query(base_a, QUERY, "fleet-smoke-trace")
+        compiles_a = _metric(base_a, "dsql_compiles_total")
+        if not compiles_a:
+            return fail(f"replica A reported no compiles: {compiles_a}")
+        res_b = _run_query(base_b, QUERY, "fleet-smoke-trace")
+        if res_b["data"] != res_a["data"]:
+            return fail(f"replica answers differ: {res_b['data'][:2]} "
+                        f"vs {res_a['data'][:2]}")
+        compiles_b = _metric(base_b, "dsql_compiles_total")
+        hits_b = _metric(base_b, "dsql_program_store_hits_total")
+        if compiles_b != 0:
+            return fail(f"replica B compiled ({compiles_b}) instead of "
+                        "serving A's programs warm")
+        if not hits_b:
+            return fail(f"replica B shows no program-store hits: {hits_b}")
+        print(f"ok warmth: A compiled {compiles_a:.0f}, B served warm "
+              f"(compiles=0, store hits={hits_b:.0f})")
+
+        # -- 2. /v1/fleet reconciles with per-replica /v1/engine ----------
+        eng_a, _ = _req(f"{base_a}/v1/engine")
+        eng_b, _ = _req(f"{base_b}/v1/engine")
+        for eng, rid in ((eng_a, "r-a"), (eng_b, "r-b")):
+            if eng.get("fleet", {}).get("replica") != rid:
+                return fail(f"/v1/engine fleet stamp wrong: {eng.get('fleet')}")
+        deadline = time.time() + 10
+        while True:
+            snap, _ = _req(f"{base_a}/v1/fleet")
+            rows = {r["replica"]: r for r in snap["replicas"]}
+            # fleet total must equal the sum of what each replica
+            # exports for itself on /metrics
+            want = int(_metric(base_a, "dsql_server_queries_total")
+                       + _metric(base_b, "dsql_server_queries_total"))
+            if ({"r-a", "r-b"} <= set(rows)
+                    and rows["r-a"]["alive"] and rows["r-b"]["alive"]
+                    and snap["totals"]["serverQueries"] == want):
+                break
+            if time.time() > deadline:
+                return fail(f"/v1/fleet never reconciled: totals="
+                            f"{snap['totals']} want serverQueries={want}")
+            time.sleep(0.3)
+        if rows["r-a"]["pid"] != eng_a["pid"] or \
+                rows["r-b"]["pid"] != eng_b["pid"]:
+            return fail(f"heartbeat pids disagree with /v1/engine: {rows}")
+        if snap["totals"]["warmServes"] < 1:
+            return fail(f"fleet totals show no warm serves: "
+                        f"{snap['totals']}")
+        snap_b, _ = _req(f"{base_b}/v1/fleet")
+        if {r["replica"] for r in snap_b["replicas"]} != set(rows):
+            return fail("replicas disagree on the registry")
+        print(f"ok registry: 2 replicas alive, fleet serverQueries="
+              f"{snap['totals']['serverQueries']}, warmServes="
+              f"{snap['totals']['warmServes']:.0f}")
+
+        # -- 3. one trace stitched across replicas ------------------------
+        rows_ev = [e for e in fleet.merged_events_rows()
+                   if e.get("trace") == "fleet-smoke-trace"]
+        rids = {e["replica"] for e in rows_ev}
+        if rids != {"r-a", "r-b"}:
+            return fail(f"trace not stitched across replicas: {rids}")
+        if [e["unix"] for e in rows_ev] != \
+                sorted(e["unix"] for e in rows_ev):
+            return fail("merged trace events out of timestamp order")
+        # and over the wire with the composite cursor
+        with urllib.request.urlopen(
+                f"{base_a}/v1/events?fleet=1&limit=5000",
+                timeout=60) as r:
+            cur = r.headers["X-DSQL-Cursor"]
+            wire = [json.loads(x) for x in r.read().splitlines() if x]
+        wire_rids = {e["replica"] for e in wire
+                     if e.get("trace") == "fleet-smoke-trace"}
+        if wire_rids != {"r-a", "r-b"} or ":" not in cur:
+            return fail(f"/v1/events?fleet=1 not merged: {wire_rids} "
+                        f"cursor={cur!r}")
+        print(f"ok trace: fleet-smoke-trace spans {sorted(rids)} in "
+              f"{len(rows_ev)} merged events, cursor {cur!r}")
+
+        # -- 4. /metrics replica label ------------------------------------
+        for base, rid in ((base_a, "r-a"), (base_b, "r-b")):
+            with urllib.request.urlopen(f"{base}/metrics",
+                                        timeout=60) as r:
+                lines = [ln for ln in r.read().decode().splitlines()
+                         if ln and not ln.startswith("#")]
+            tag = f'replica="{rid}"'
+            if not lines or not all(tag in ln for ln in lines):
+                bad = [ln for ln in lines if tag not in ln][:3]
+                return fail(f"unlabeled series on {rid}: {bad}")
+        print(f"ok metrics: every series labeled, {len(lines)} on r-b")
+    finally:
+        for p in (proc_a, proc_b):
+            if p is not None:
+                p.terminate()
+        for p in (proc_a, proc_b):
+            if p is not None:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+    # -- 5. disarmed baseline: zero imports, 404, label-free wire --------
+    child_code = (
+        "import json, sys, urllib.error, urllib.request\n"
+        "from dask_sql_tpu import Context\n"
+        "c = Context()\n"
+        "c.create_table('t', {'a': [1, 2, 3, 4]})\n"
+        "r1 = c.sql('SELECT SUM(a) AS s FROM t').to_pylist()\n"
+        "assert r1 == [[10]], r1\n"
+        "assert 'dask_sql_tpu.runtime.fleet' not in sys.modules, \\\n"
+        "    'fleet imported with DSQL_FLEET_DIR unset'\n"
+        "srv = c.run_server(host='127.0.0.1', port=0, blocking=False)\n"
+        "base = f'http://127.0.0.1:{srv.server_port}'\n"
+        "with urllib.request.urlopen(base + '/v1/statement'.replace("
+        "'/v1/statement', '/metrics')) as r:\n"
+        "    m = r.read().decode()\n"
+        "assert 'replica=' not in m, 'replica label leaked while off'\n"
+        "try:\n"
+        "    urllib.request.urlopen(base + '/v1/fleet')\n"
+        "    raise SystemExit('/v1/fleet served while disarmed')\n"
+        "except urllib.error.HTTPError as e:\n"
+        "    assert e.code == 404, e.code\n"
+        "req = urllib.request.Request(base + '/v1/statement',\n"
+        "                             data=b'SELECT SUM(a) AS s FROM t')\n"
+        "with urllib.request.urlopen(req) as r:\n"
+        "    p = json.loads(r.read())\n"
+        "while 'nextUri' in p:\n"
+        "    with urllib.request.urlopen(p['nextUri']) as r:\n"
+        "        p = json.loads(r.read())\n"
+        "assert p['data'] == [[10]], p\n"
+        "assert 'replica' not in p['stats'], p['stats']\n"
+        "assert 'dask_sql_tpu.runtime.fleet' not in sys.modules\n"
+        "srv.shutdown()\n"
+        "print('child ok')\n"
+    )
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("DSQL_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", child_code], env=env,
+                          capture_output=True, timeout=600)
+    if proc.returncode != 0 or b"child ok" not in proc.stdout:
+        return fail(f"disarmed-baseline child: "
+                    f"{proc.stderr.decode()[-800:]}")
+    print("ok disarmed: zero fleet imports, /v1/fleet 404, "
+          "label-free metrics, identical results")
+
+    print("fleet obs smoke PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

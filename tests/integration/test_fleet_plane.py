@@ -1,0 +1,211 @@
+"""Fleet plane end-to-end: two REAL child replica processes writing
+into one shared DSQL_FLEET_DIR, merged ordering + composite-cursor
+monotonicity read back by the parent, and the server surface
+(/v1/fleet, /v1/events?fleet=1, /metrics replica label, 404 when
+disarmed)."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+# what each child replica runs: arm the fleet, publish a handful of
+# events that share one trace id, heartbeat, exit 0
+_CHILD = """
+import os, sys, time
+from dask_sql_tpu.runtime import fleet, events
+rid = os.environ["DSQL_REPLICA_ID"]
+assert fleet.ensure_armed()
+for i in range(int(sys.argv[1])):
+    events.publish("child.tick", trace=sys.argv[2],
+                   detail={"i": i, "rid": rid})
+    time.sleep(0.01)
+fleet.write_heartbeat_now()
+print(fleet.replica_id())
+"""
+
+
+def _spawn_child(fleet_dir, rid, n_events, trace):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("DSQL_")}
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "DSQL_FLEET_DIR": str(fleet_dir),
+        "DSQL_REPLICA_ID": rid,
+        "DSQL_FLEET_BEAT_S": "0.2",
+    })
+    return subprocess.Popen(
+        [sys.executable, "-c", _CHILD, str(n_events), trace],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+
+@pytest.fixture()
+def fleet_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("DSQL_FLEET_DIR", str(tmp_path))
+    monkeypatch.setenv("DSQL_FLEET_BEAT_S", "0.2")
+    monkeypatch.setenv("DSQL_REPLICA_ID", "r-parent")
+    for key in ("DSQL_EVENTS", "DSQL_EVENTS_FILE", "DSQL_HISTORY_FILE"):
+        monkeypatch.delenv(key, raising=False)
+    from dask_sql_tpu.runtime import events
+    from dask_sql_tpu.runtime import fleet as fl
+    fl._reset_for_tests()
+    events._reset_for_tests()
+    yield tmp_path, fl
+    fl._reset_for_tests()
+    events._reset_for_tests()
+    for key in ("DSQL_EVENTS", "DSQL_EVENTS_FILE", "DSQL_HISTORY_FILE"):
+        os.environ.pop(key, None)
+
+
+def test_two_child_replicas_merge_and_cursor(fleet_env):
+    tmp_path, fleet = fleet_env
+    p1 = _spawn_child(tmp_path, "r-one", 5, "trace-x")
+    p2 = _spawn_child(tmp_path, "r-two", 5, "trace-x")
+    for p in (p1, p2):
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, err.decode()
+    # both heartbeats registered (children exited < TTL ago → alive)
+    reps = {r["replica"]: r for r in fleet.read_replicas()}
+    assert {"r-one", "r-two"} <= set(reps)
+    # merged stream: globally timestamp-ordered, per-replica seq order
+    # preserved, one trace id stitched across both replicas
+    rows = fleet.merged_events_rows()
+    assert len(rows) == 10
+    assert [r["unix"] for r in rows] == sorted(r["unix"] for r in rows)
+    for rid in ("r-one", "r-two"):
+        seqs = [r["seq"] for r in rows if r["replica"] == rid]
+        assert seqs == sorted(seqs) and len(seqs) == 5
+    assert {r["replica"] for r in rows if r["trace"] == "trace-x"} == \
+        {"r-one", "r-two"}
+    # composite cursor walks the same 10 events exactly once, in order
+    seen, cursor = [], ""
+    while True:
+        batch, cursor = fleet.read_merged_since(cursor, limit=3)
+        if not batch:
+            break
+        seen.extend(batch)
+    assert [(r["replica"], r["seq"]) for r in seen] == \
+        [(r["replica"], r["seq"]) for r in rows]
+
+
+def test_dead_child_expires_from_registry(fleet_env):
+    tmp_path, fleet = fleet_env
+    p = _spawn_child(tmp_path, "r-brief", 1, "t")
+    out, err = p.communicate(timeout=300)
+    assert p.returncode == 0, err.decode()
+    assert any(r["replica"] == "r-brief" for r in fleet.read_replicas())
+    # past the TTL the killed replica reads as dead, without deletion
+    deadline = time.time() + 3 * fleet.ttl_s()
+    while time.time() < deadline:
+        rows = [r for r in fleet.read_replicas()
+                if r["replica"] == "r-brief"]
+        if rows and not rows[0]["alive"]:
+            break
+        time.sleep(0.1)
+    assert rows and rows[0]["alive"] is False
+
+
+# ---------------------------------------------------------------------------
+# the server surface
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def fleet_server(fleet_env):
+    tmp_path, fleet = fleet_env
+    from dask_sql_tpu.context import Context
+    from dask_sql_tpu.server.app import run_server
+    context = Context()
+    context.create_table("t", {"a": np.arange(8, dtype=np.int64)})
+    srv = run_server(context=context, host="127.0.0.1", port=0,
+                     blocking=False)
+    yield f"http://127.0.0.1:{srv.server_port}", tmp_path, fleet
+    srv.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read())
+
+
+def test_v1_fleet_snapshot_reconciles_with_engine(fleet_server):
+    base, tmp_path, fleet = fleet_server
+    snap = _get(f"{base}/v1/fleet")
+    for key in ("dir", "replica", "replicas", "totals", "slo"):
+        assert key in snap, key
+    assert snap["replica"] == "r-parent"
+    rows = {r["replica"]: r for r in snap["replicas"]}
+    assert rows["r-parent"]["alive"] is True
+    engine = _get(f"{base}/v1/engine")
+    assert engine["fleet"]["replica"] == "r-parent"
+    assert engine["fleet"]["dir"] == str(tmp_path)
+    # the parent's heartbeat row agrees with its own /v1/engine
+    assert rows["r-parent"]["pid"] == engine["pid"]
+
+
+def test_v1_events_fleet_mode_composite_cursor(fleet_server):
+    base, tmp_path, fleet = fleet_server
+    from dask_sql_tpu.runtime import events
+    events.publish("srv.alpha", trace="t-s", detail={})
+    events.publish("srv.beta", trace="t-s", detail={})
+    req = urllib.request.Request(f"{base}/v1/events?fleet=1&limit=1")
+    with urllib.request.urlopen(req) as r:
+        lines = [json.loads(x) for x in r.read().splitlines() if x]
+        cur1 = r.headers["X-DSQL-Cursor"]
+    assert len(lines) == 1 and lines[0]["replica"] == "r-parent"
+    assert ":" in cur1                 # composite replica:seq cursor
+    req = urllib.request.Request(
+        f"{base}/v1/events?fleet=1&cursor={urllib.parse.quote(cur1)}")
+    with urllib.request.urlopen(req) as r:
+        lines2 = [json.loads(x) for x in r.read().splitlines() if x]
+        cur2 = r.headers["X-DSQL-Cursor"]
+    types = [x["type"] for x in lines2]
+    assert lines[0]["type"] not in types        # no replay past cursor
+    assert "srv.beta" in types
+    assert fleet.parse_cursor(cur2)["r-parent"] >= \
+        fleet.parse_cursor(cur1)["r-parent"]
+
+
+def test_metrics_carry_replica_label(fleet_server):
+    base, _, _ = fleet_server
+    with urllib.request.urlopen(f"{base}/metrics") as r:
+        body = r.read().decode()
+    lines = [ln for ln in body.splitlines()
+             if ln and not ln.startswith("#")]
+    assert lines
+    assert all('replica="r-parent"' in ln for ln in lines), \
+        [ln for ln in lines if 'replica="r-parent"' not in ln][:3]
+
+
+# ---------------------------------------------------------------------------
+# disarmed: 404 + unlabeled wire
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def plain_server(monkeypatch):
+    monkeypatch.delenv("DSQL_FLEET_DIR", raising=False)
+    from dask_sql_tpu.context import Context
+    from dask_sql_tpu.server.app import run_server
+    context = Context()
+    context.create_table("t", {"a": np.arange(4, dtype=np.int64)})
+    srv = run_server(context=context, host="127.0.0.1", port=0,
+                     blocking=False)
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_v1_fleet_404_when_disarmed(plain_server):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(f"{plain_server}/v1/fleet")
+    assert exc.value.code == 404
+
+
+def test_metrics_unlabeled_when_disarmed(plain_server):
+    with urllib.request.urlopen(f"{plain_server}/metrics") as r:
+        body = r.read().decode()
+    assert 'replica="' not in body

@@ -1,0 +1,246 @@
+"""Unit tests for the fleet plane (runtime/fleet.py): replica identity,
+heartbeat TTL/corruption tolerance, merged rings with composite cursors,
+tenant-gauge cardinality, and the zero-import disabled path."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+@pytest.fixture()
+def fleet(tmp_path, monkeypatch):
+    """Armed fleet module in a private dir; tears down the heartbeater
+    and the env defaults ensure_armed installs (setdefault writes are
+    invisible to monkeypatch, so clear them explicitly)."""
+    monkeypatch.setenv("DSQL_FLEET_DIR", str(tmp_path))
+    monkeypatch.setenv("DSQL_FLEET_BEAT_S", "0.1")
+    monkeypatch.setenv("DSQL_REPLICA_ID", "r-a")
+    for key in ("DSQL_EVENTS", "DSQL_EVENTS_FILE", "DSQL_HISTORY_FILE"):
+        monkeypatch.delenv(key, raising=False)
+    from dask_sql_tpu.runtime import events
+    from dask_sql_tpu.runtime import fleet as fl
+    fl._reset_for_tests()
+    events._reset_for_tests()
+    yield fl
+    fl._reset_for_tests()
+    events._reset_for_tests()
+    for key in ("DSQL_EVENTS", "DSQL_EVENTS_FILE", "DSQL_HISTORY_FILE"):
+        os.environ.pop(key, None)
+
+
+# ---------------------------------------------------------------------------
+# identity + arming
+# ---------------------------------------------------------------------------
+
+def test_replica_id_sanitized(fleet, monkeypatch):
+    monkeypatch.setenv("DSQL_REPLICA_ID", "ok-Name_1.x")
+    fleet._reset_for_tests()
+    assert fleet.replica_id() == "ok-Name_1.x"
+    monkeypatch.setenv("DSQL_REPLICA_ID", "bad id/../../etc")
+    fleet._reset_for_tests()
+    rid = fleet.replica_id()
+    assert "/" not in rid and " " not in rid
+    monkeypatch.delenv("DSQL_REPLICA_ID")
+    fleet._reset_for_tests()
+    assert fleet.replica_id().endswith(f"-{os.getpid()}")
+
+
+def test_ensure_armed_installs_ring_redirection(fleet):
+    assert fleet.ensure_armed() is True
+    assert os.environ["DSQL_EVENTS"] == "1"
+    assert os.environ["DSQL_EVENTS_FILE"] == fleet.events_path("r-a")
+    assert os.environ["DSQL_HISTORY_FILE"] == fleet.history_path("r-a")
+    # idempotent, and explicit user values win over the defaults
+    assert fleet.ensure_armed() is True
+
+
+def test_ensure_armed_noop_when_unset(fleet, monkeypatch):
+    monkeypatch.delenv("DSQL_FLEET_DIR")
+    fleet._reset_for_tests()
+    assert fleet.ensure_armed() is False
+    assert "DSQL_EVENTS" not in os.environ
+
+
+# ---------------------------------------------------------------------------
+# heartbeats: TTL expiry and corruption tolerance
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_ttl_expiry_of_dead_replica(fleet):
+    fleet.ensure_armed()
+    # a "killed" replica: its heartbeat file exists but the beat is
+    # older than the TTL — must be listed but not alive
+    stale = {"replica": "r-dead", "pid": 99999, "host": "gone",
+             "started": time.time() - 100,
+             "beat": time.time() - 100}
+    with open(fleet.heartbeat_path("r-dead"), "w") as f:
+        json.dump(stale, f)
+    reps = {r["replica"]: r for r in fleet.read_replicas()}
+    assert reps["r-a"]["alive"] is True
+    assert reps["r-dead"]["alive"] is False
+    assert reps["r-dead"]["age_s"] > fleet.ttl_s()
+    # snapshot totals only sum the alive replicas
+    snap = fleet.snapshot()
+    assert snap["totals"]["replicas"] == 2
+    assert snap["totals"]["alive"] == 1
+
+
+def test_corrupt_and_torn_heartbeats_skipped(fleet):
+    fleet.ensure_armed()
+    rd = fleet.replicas_dir()
+    with open(os.path.join(rd, "torn.json"), "w") as f:
+        f.write('{"replica": "r-torn", "pid"')       # torn mid-write
+    with open(os.path.join(rd, "scalar.json"), "w") as f:
+        f.write("42")                                # valid JSON, not a dict
+    with open(os.path.join(rd, "empty.json"), "w") as f:
+        pass
+    with open(os.path.join(rd, "anon.json"), "w") as f:
+        json.dump({"pid": 1}, f)                     # dict, no identity
+    reps = fleet.read_replicas()
+    assert [r["replica"] for r in reps] == ["r-a"]
+
+
+def test_heartbeat_payload_shape(fleet):
+    fleet.ensure_armed()
+    hb = fleet.collect_heartbeat()
+    for key in ("replica", "pid", "host", "started", "beat",
+                "counters", "scheduler", "memory", "programStore"):
+        assert key in hb, key
+    assert hb["replica"] == "r-a"
+    assert hb["pid"] == os.getpid()
+
+
+# ---------------------------------------------------------------------------
+# merged rings + composite cursor
+# ---------------------------------------------------------------------------
+
+def _write_ring(fleet, rid, recs):
+    with open(fleet.events_path(rid), "a") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_merged_events_timestamp_order(fleet):
+    fleet.ensure_armed()
+    base = time.time()
+    _write_ring(fleet, "r-b", [
+        {"seq": 1, "unix": base + 0.2, "pid": 2, "trace": "t1",
+         "type": "b.first"},
+        {"seq": 2, "unix": base + 0.4, "pid": 2, "trace": "t1",
+         "type": "b.second"},
+    ])
+    _write_ring(fleet, "r-c", [
+        {"seq": 1, "unix": base + 0.1, "pid": 3, "trace": "t1",
+         "type": "c.first"},
+        {"seq": 2, "unix": base + 0.3, "pid": 3, "trace": "t2",
+         "type": "c.second"},
+    ])
+    rows = fleet.merged_events_rows()
+    assert [r["type"] for r in rows] == [
+        "c.first", "b.first", "c.second", "b.second"]
+    assert [r["replica"] for r in rows] == ["r-c", "r-b", "r-c", "r-b"]
+    # one trace id stitches across replicas
+    t1 = [r for r in rows if r["trace"] == "t1"]
+    assert {r["replica"] for r in t1} == {"r-b", "r-c"}
+
+
+def test_composite_cursor_monotonic_and_lossless(fleet):
+    fleet.ensure_armed()
+    base = time.time()
+    _write_ring(fleet, "r-b", [
+        {"seq": i, "unix": base + i * 0.1, "pid": 2, "type": f"b.{i}"}
+        for i in range(1, 6)])
+    _write_ring(fleet, "r-c", [
+        {"seq": i, "unix": base + i * 0.1 + 0.05, "pid": 3,
+         "type": f"c.{i}"} for i in range(1, 6)])
+    seen, cursor = [], ""
+    for _ in range(20):
+        batch, nxt = fleet.read_merged_since(cursor, limit=3)
+        if not batch:
+            assert nxt == cursor        # cursor never regresses when idle
+            break
+        seen.extend(batch)
+        cursor = nxt
+    assert [r["type"] for r in seen if r["replica"] == "r-b"] == \
+        [f"b.{i}" for i in range(1, 6)]
+    assert [r["type"] for r in seen if r["replica"] == "r-c"] == \
+        [f"c.{i}" for i in range(1, 6)]
+    assert len(seen) == 10              # lossless: every event exactly once
+    # globally timestamp-ordered
+    assert [r["unix"] for r in seen] == sorted(r["unix"] for r in seen)
+
+
+def test_cursor_roundtrip_tolerant(fleet):
+    assert fleet.parse_cursor(None) == {}
+    assert fleet.parse_cursor("") == {}
+    assert fleet.parse_cursor("garbage") == {}
+    assert fleet.parse_cursor("r-a:zzz;r-b:3") == {"r-b": 3}
+    cur = {"r-a": 7, "r-b": 3}
+    assert fleet.parse_cursor(fleet.encode_cursor(cur)) == cur
+
+
+def test_merged_query_rows_stamp_replica(fleet):
+    fleet.ensure_armed()
+    with open(fleet.history_path("r-b"), "a") as f:
+        f.write(json.dumps({"kind": "query", "unix": time.time(),
+                            "sql": "SELECT 1", "wall_ms": 3.0}) + "\n")
+        f.write(json.dumps({"kind": "stage", "unix": time.time()}) + "\n")
+    rows = fleet.merged_query_rows()
+    assert len(rows) == 1 and rows[0]["replica"] == "r-b"
+
+
+# ---------------------------------------------------------------------------
+# tenant-gauge cardinality bound
+# ---------------------------------------------------------------------------
+
+def test_tenant_gauge_cardinality_bounded(monkeypatch):
+    monkeypatch.setenv("DSQL_MAX_TENANT_GAUGES", "3")
+    from dask_sql_tpu.runtime import events, telemetry
+    events._reset_for_tests()
+    for i in range(8):
+        events.observe_tenant(f"tenant-{i}", "interactive", 1.0)
+    gauges = {k: v for k, v in telemetry.REGISTRY.snapshot()["gauges"].items()
+              if k.startswith("slo_attainment_tenant_")}
+    named = [k for k in gauges if not k.endswith("_other")]
+    assert len(named) == 3
+    assert "slo_attainment_tenant__other" in gauges
+    # existing tenants keep their own series even after overflow
+    events.observe_tenant("tenant-0", "interactive", 1.0)
+    snap = telemetry.REGISTRY.snapshot()["gauges"]
+    assert "slo_attainment_tenant_tenant-0" in snap
+    events._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# the zero-import disabled path
+# ---------------------------------------------------------------------------
+
+def test_disabled_query_never_imports_fleet():
+    """With DSQL_FLEET_DIR unset an end-to-end query must leave
+    runtime.fleet out of sys.modules — the fleet plane costs one env
+    read when off."""
+    code = (
+        "import sys\n"
+        "from dask_sql_tpu import Context\n"
+        "c = Context()\n"
+        "c.create_table('t', {'a': [1, 2, 3]})\n"
+        "assert c.sql('SELECT SUM(a) AS s FROM t').to_pylist() == [[6]]\n"
+        "assert 'dask_sql_tpu.runtime.fleet' not in sys.modules, \\\n"
+        "    'disabled path imported the fleet plane'\n"
+    )
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("DSQL_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr.decode()
+
+
+def test_system_replicas_empty_when_disarmed(monkeypatch):
+    monkeypatch.delenv("DSQL_FLEET_DIR", raising=False)
+    from dask_sql_tpu.runtime import system_tables as st
+    t = st.build("replicas")
+    assert t.num_rows == 0
+    assert "replica" in t.names
