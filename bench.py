@@ -435,6 +435,116 @@ def _stage_main():
         sys.stderr.flush()
         os._exit(0)
 
+    if os.environ.get("BENCH_AUTOPILOT_CHILD") == "1":
+        # AUTOPILOT mode (parent opts in with BENCH_AUTOPILOT=1): the
+        # unattended-vs-hand-tuned comparison.  A hand-tuned operator
+        # pre-creates a matview and queries it by name; the unattended
+        # workload just repeats its aggregate and lets the autopilot
+        # discover, materialize and maintain it.  Both pay the same
+        # append-then-read rounds; the journaled ratio is the price of
+        # leaving the tuning to the advisor (~1.0 = converged).
+        import pandas as _apd
+
+        from dask_sql_tpu.runtime import telemetry as _atel
+
+        # maintained state is a result-cache tenant (see the MV mode
+        # above): re-arm the budget the cold-measurement pin zeroed
+        os.environ["DSQL_RESULT_CACHE_MB"] = cache_mb if cache_mb else "256"
+        TUNED_SQL = ("SELECT l_returnflag, l_linestatus, "
+                     "SUM(l_quantity) AS sum_qty, COUNT(*) AS n "
+                     "FROM lineitem GROUP BY l_returnflag, l_linestatus")
+        AUTO_SQL = ("SELECT l_linestatus, "
+                    "SUM(l_extendedprice) AS sum_price, "
+                    "AVG(l_discount) AS avg_disc, COUNT(*) AS n "
+                    "FROM lineitem GROUP BY l_linestatus")
+        rec_ap = {}
+        try:
+            li = _apd.read_feather(os.path.join(
+                os.environ["BENCH_DATA_DIR"], "lineitem.feather"))
+            # untuned reference: one full recompute of the aggregate
+            t0a = time.perf_counter()
+            c.sql(AUTO_SQL, return_futures=False)
+            recompute_sec = time.perf_counter() - t0a
+
+            # hand-tuned: operator-created view, queried by name; the
+            # warm-up append pays the one-time delta-plan compiles
+            c.sql(f"CREATE MATERIALIZED VIEW bench_ap AS {TUNED_SQL}")
+            c.append_rows("lineitem", li.sample(n=1000, random_state=3))
+            c.sql("SELECT * FROM bench_ap", return_futures=False)
+            tuned = []
+            for r in range(3):
+                if left() < 30:
+                    break
+                c.append_rows("lineitem",
+                              li.sample(n=1000, random_state=20 + r))
+                t0a = time.perf_counter()
+                c.sql("SELECT * FROM bench_ap", return_futures=False)
+                tuned.append(time.perf_counter() - t0a)
+
+            # unattended: arm the advisor, repeat the aggregate until it
+            # is the top candidate (the second run is a cache hit whose
+            # count-only envelope still accrues), tick, then pay the
+            # same append-then-read rounds served from the auto view
+            os.environ["DSQL_HISTORY_FILE"] = os.path.join(
+                os.environ["BENCH_DATA_DIR"], "autopilot_history.jsonl")
+            os.environ["DSQL_AUTOPILOT"] = "1"
+            os.environ["DSQL_AUTOPILOT_INTERVAL_S"] = "0"
+            os.environ["DSQL_AUTOPILOT_MIN_HITS"] = "2"
+            from dask_sql_tpu.runtime import autopilot as _ap
+            c0a = _atel.REGISTRY.counters()
+            c.sql(AUTO_SQL, return_futures=False)
+            c.sql(AUTO_SQL, return_futures=False)
+            _ap.tick(c)
+            unattended = []
+            served = None
+            for r in range(3):
+                if left() < 30:
+                    break
+                c.append_rows("lineitem",
+                              li.sample(n=1000, random_state=40 + r))
+                t0a = time.perf_counter()
+                served = c.sql(AUTO_SQL, return_futures=False)
+                unattended.append(time.perf_counter() - t0a)
+            # exactness: the served answer vs a from-scratch recompute
+            # with the advisor disarmed (epoch already bumped, so this
+            # is a genuine cache miss)
+            os.environ["DSQL_AUTOPILOT"] = "0"
+            recomputed = c.sql(AUTO_SQL, return_futures=False)
+            os.environ["DSQL_AUTOPILOT"] = "1"
+            cols = list(recomputed.columns)
+            try:
+                _apd.testing.assert_frame_equal(
+                    served.sort_values(cols).reset_index(drop=True),
+                    recomputed.sort_values(cols).reset_index(drop=True),
+                    check_dtype=False, rtol=1e-6, atol=1e-6)
+                match = True
+            except Exception:  # noqa: BLE001 - any mismatch is "no"
+                match = False
+            c1a = _atel.REGISTRY.counters()
+
+            def dlta(k):
+                return int(c1a.get(k, 0) - c0a.get(k, 0))
+
+            tg = _geomean(tuned) if tuned else 0.0
+            ug = _geomean(unattended) if unattended else 0.0
+            rec_ap = {
+                "recompute_sec": round(recompute_sec, 4),
+                "tuned_geomean_sec": round(tg, 4),
+                "unattended_geomean_sec": round(ug, 4),
+                "vs_tuned_geomean": (round(ug / tg, 3) if tg > 0
+                                     else None),
+                "auto_views": _ap.engine_section()["managedViews"],
+                "autopilot_mv_creates": dlta("autopilot_mv_creates"),
+                "autopilot_mv_serves": dlta("autopilot_mv_serves"),
+                "match": match,
+            }
+        except Exception as e:
+            rec_ap = {"error": repr(e)[:300]}
+        emit({"autopilot": rec_ap})
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
+
     if os.environ.get("BENCH_FLEET_CHILD") == "1":
         # FLEET mode (parent opts in with BENCH_FLEET=1): two server
         # REPLICAS on one shared DSQL_FLEET_DIR + a FRESH shared
@@ -1116,6 +1226,7 @@ def main():
         shard_scaling = None
         ooc_evidence = None
         mv_evidence = None
+        autopilot_evidence = None
         fleet_evidence = None
         load_sec = warmup_sec = 0.0
         try:
@@ -1172,6 +1283,8 @@ def main():
                         ooc_evidence = rec["ooc"] or None
                     elif "mv" in rec:
                         mv_evidence = rec["mv"] or None
+                    elif "autopilot" in rec:
+                        autopilot_evidence = rec["autopilot"] or None
                     elif "fleet" in rec:
                         fleet_evidence = rec["fleet"] or None
                     elif "slo_attainment" in rec:
@@ -1256,6 +1369,12 @@ def main():
             "fleet_warm_serves": (fleet_evidence or {}).get("warm_serves"),
             "fleet_plan_cache_hit_rate":
                 (fleet_evidence or {}).get("plan_cache_hit_rate"),
+            # autopilot (ISSUE 19, BENCH_AUTOPILOT=1): the unattended
+            # workload's steady-state geomean over the hand-tuned one
+            # (~1.0 = the advisor converged to the operator's setup);
+            # None when the pass never ran
+            "autopilot_vs_tuned_geomean":
+                (autopilot_evidence or {}).get("vs_tuned_geomean"),
         }
         if not done:
             out = {"metric": "tpch_q1_q22_geomean_wall", "value": -1,
@@ -1351,6 +1470,11 @@ def main():
                     # lineitem, with the mv refresh hit-rate and the
                     # served-vs-recomputed exactness verdict
                     "mv": mv_evidence,
+                    # autopilot evidence (runtime/autopilot.py,
+                    # BENCH_AUTOPILOT=1): unattended vs hand-tuned
+                    # append-then-read rounds, the advisor's auto-created
+                    # views/serves, and the exactness verdict
+                    "autopilot": autopilot_evidence,
                     # fleet-plane evidence (runtime/fleet.py,
                     # BENCH_FLEET=1): two replicas on one fleet dir +
                     # program store under a Zipf multi-tenant burst —
@@ -1755,6 +1879,30 @@ def main():
             proc.kill()
             proc.communicate()  # reap
             state["stage_meta"].append({"attempt": "mv",
+                                        "error": "timeout"})
+        finally:
+            state["child"] = None
+
+    # AUTOPILOT pass (opt-in: BENCH_AUTOPILOT=1): unattended convergence
+    # vs a hand-tuned matview under the same append-then-read rounds —
+    # journals the unattended-vs-tuned geomean ratio the perf sentinel
+    # shows as an informational row (runtime/autopilot.py)
+    ap_left = deadline - EMIT_MARGIN - time.monotonic()
+    if os.environ.get("BENCH_AUTOPILOT") == "1" and ap_left > 60:
+        env = dict(env_base, BENCH_AUTOPILOT_CHILD="1",
+                   BENCH_STAGE_QUERIES="1",
+                   BENCH_CHILD_DEADLINE=str(time.time() + ap_left - 10))
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        state["child"] = proc
+        try:
+            proc.communicate(timeout=ap_left)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()  # reap
+            state["stage_meta"].append({"attempt": "autopilot",
                                         "error": "timeout"})
         finally:
             state["child"] = None

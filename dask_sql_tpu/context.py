@@ -542,6 +542,29 @@ class Context:
             from .physical.streaming import (execute_streaming,
                                              plan_references_chunked)
             if plan_references_chunked(plan, self):
+                if (os.environ.get("DSQL_AUTOPILOT", "0").strip()
+                        not in ("", "0")):
+                    # adaptive re-planning covers the streaming tier too
+                    # (the grace-join partition hint lives there), but the
+                    # fingerprint rides a SEPARATE attr: chunked sources
+                    # have no stable content identity, so they must stay
+                    # out of the flight recorder's plan_fp stats and out
+                    # of system.view_candidates
+                    from .runtime import autopilot as _ap
+                    from .runtime import flight_recorder as _fr
+                    fp = None
+                    try:
+                        fp = _fr.plan_fingerprint(plan, self)
+                        if fp is not None:
+                            _tel.annotate(autopilot_fp=fp)
+                    except Exception:
+                        logger.debug("plan fingerprint failed",
+                                     exc_info=True)
+                    _ap.begin_query(fp, self)
+                    try:
+                        return execute_streaming(plan, self)
+                    finally:
+                        _ap.end_query()
                 return execute_streaming(plan, self)
         # result cache: an identical plan over unmutated tables (same
         # catalog epochs + table uids) replays its materialized result and
@@ -560,13 +583,30 @@ class Context:
                     _tel.inc("result_cache_hits")
                     _tel.annotate(result_cache="hit",
                                   result_cache_tier=tier)
+                    # the hit bypasses execution, so stamp the plan
+                    # fingerprint HERE: the cache-hit envelope keeps the
+                    # hot query's rank in system.view_candidates accruing
+                    # (the candidate-starvation fix)
+                    if os.environ.get("DSQL_HISTORY_FILE"):
+                        try:
+                            from .runtime import flight_recorder as _fr
+                            fp = _fr.plan_fingerprint(plan, self)
+                            if fp is not None:
+                                _tel.annotate(plan_fp=fp)
+                        except Exception:
+                            logger.debug("plan fingerprint failed",
+                                         exc_info=True)
                     return table
                 _tel.inc("result_cache_misses")
+        autopilot_on = (os.environ.get("DSQL_AUTOPILOT", "0").strip()
+                        not in ("", "0"))
         # flight recorder (runtime/flight_recorder.py): stamp the canonical
         # plan fingerprint on the execute span so the completion envelope
         # and the EWMA statistics history key to it.  Env-gated BEFORE the
         # import — with the recorder off this path allocates nothing.
-        if os.environ.get("DSQL_HISTORY_FILE"):
+        # (autopilot keys its hints on the same fingerprint)
+        fp = None
+        if os.environ.get("DSQL_HISTORY_FILE") or autopilot_on:
             try:
                 from .runtime import flight_recorder as _fr
                 fp = _fr.plan_fingerprint(plan, self)
@@ -574,37 +614,55 @@ class Context:
                     _tel.annotate(plan_fp=fp)
             except Exception:
                 logger.debug("plan fingerprint failed", exc_info=True)
-        # SPMD multi-chip backend (parallel/spmd.py): with a device mesh
-        # attached, stages execute as explicit shard_map programs over
-        # row-sharded tables.  None means the plan is outside the SPMD
-        # envelope or a runtime safety flag tripped — the single-device
-        # tiers below serve it instead.
-        result = None
-        span = _tel.current_span()
-        if self.mesh is not None:
-            from .parallel.spmd import try_execute_spmd
-            result = try_execute_spmd(plan, self)
-            if result is not None and span is not None:
-                span.attrs.setdefault("tier", "spmd")
-        # whole-plan jit (one device dispatch per query); falls back to
-        # the eager per-op executor for plan shapes outside its subset
-        if result is None:
-            from .physical.compiled import try_execute_compiled
-            result = try_execute_compiled(plan, self)
-        # execution-tier annotation (tiered execution, physical/compiled):
-        # "compiled", "eager", or the gate's own "eager-compiling" — the
-        # gate's verdict wins, so only fill in when it said nothing
-        if result is None:
-            if span is not None:
-                span.attrs.setdefault("tier", "eager")
-            result = RelExecutor(self).execute(plan)
-        elif span is not None:
-            span.attrs.setdefault("tier", "compiled")
-        # populate only on the success path: a crashed / deadline-exceeded
-        # execution raised before this line and never reaches the cache
-        if ckey is not None and result is not None and cache.put(ckey, result):
-            _tel.annotate(result_cache="store")
-        return result
+        if autopilot_on:
+            # autopilot (runtime/autopilot.py): exact repeats of a managed
+            # view's defining query answer from the maintained state, and
+            # any active re-plan hint for this fingerprint scopes to this
+            # execution (env checked before the import, same discipline)
+            from .runtime import autopilot as _ap
+            served = _ap.try_serve(plan, self)
+            if served is not None:
+                return served
+            _ap.begin_query(fp, self)
+        try:
+            # SPMD multi-chip backend (parallel/spmd.py): with a device
+            # mesh attached, stages execute as explicit shard_map programs
+            # over row-sharded tables.  None means the plan is outside the
+            # SPMD envelope or a runtime safety flag tripped — the
+            # single-device tiers below serve it instead.
+            result = None
+            span = _tel.current_span()
+            if self.mesh is not None:
+                from .parallel.spmd import try_execute_spmd
+                result = try_execute_spmd(plan, self)
+                if result is not None and span is not None:
+                    span.attrs.setdefault("tier", "spmd")
+            # whole-plan jit (one device dispatch per query); falls back to
+            # the eager per-op executor for plan shapes outside its subset
+            if result is None:
+                from .physical.compiled import try_execute_compiled
+                result = try_execute_compiled(plan, self)
+            # execution-tier annotation (tiered execution,
+            # physical/compiled): "compiled", "eager", or the gate's own
+            # "eager-compiling" — the gate's verdict wins, so only fill in
+            # when it said nothing
+            if result is None:
+                if span is not None:
+                    span.attrs.setdefault("tier", "eager")
+                result = RelExecutor(self).execute(plan)
+            elif span is not None:
+                span.attrs.setdefault("tier", "compiled")
+            # populate only on the success path: a crashed /
+            # deadline-exceeded execution raised before this line and
+            # never reaches the cache
+            if ckey is not None and result is not None \
+                    and cache.put(ckey, result):
+                _tel.annotate(result_cache="store")
+            return result
+        finally:
+            if autopilot_on:
+                from .runtime import autopilot as _ap
+                _ap.end_query()
 
     def _get_plan(self, query: A.SelectLike, sql: str = "",
                   params: Optional[list] = None) -> RelNode:

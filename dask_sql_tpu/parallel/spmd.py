@@ -325,6 +325,15 @@ class _Walker:
             both_sharded = left.sharded and right.sharded
             variant = ("exchange" if both_sharded
                        and est_build > _broadcast_rows_cap() else "broadcast")
+            if os.environ.get("DSQL_AUTOPILOT", "0").strip() not in ("", "0"):
+                # autopilot re-plan hint flips the strategy for THIS
+                # recording; the decision folds into the stage digest so
+                # the hinted plan compiles its own program (env checked
+                # before import).  "exchange" only applies when legal.
+                from ..runtime import autopilot as _ap
+                hj = _ap.current_hint("join")
+                if hj == "broadcast" or (hj == "exchange" and both_sharded):
+                    variant = hj
             di = len(self.meta["decisions"])
             self._decide("spmd_join", variant, build=build_side,
                          est_build=int(est_build),

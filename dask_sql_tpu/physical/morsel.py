@@ -445,6 +445,14 @@ def grace_join_split(join: LogicalJoin, context):
     P = min(max(-(-int(lsrc.n_rows) // max(int(lsrc.batch_rows), 1)),
                 -(-int(rsrc.n_rows) // max(int(rsrc.batch_rows), 1)),
                 1), MAX_PARTITIONS)
+    if os.environ.get("DSQL_AUTOPILOT", "0").strip() not in ("", "0"):
+        # a skew-triggered autopilot hint re-partitions finer next run
+        # (env checked before import; partition count never changes
+        # results, only run sizes)
+        from ..runtime import autopilot as _ap
+        hp = _ap.current_hint("partitions")
+        if hp:
+            P = min(max(int(hp), 1), MAX_PARTITIONS)
     runs_l = [f"g{tag}:L{p}" for p in range(P)]
     runs_r = [f"g{tag}:R{p}" for p in range(P)]
     out_run = f"g{tag}:out"

@@ -76,7 +76,7 @@ from .resilience import FatalError, TransientError, interruptible_sleep
 
 SITES = ("compile", "materialize", "stage_exec", "stage_replay",
          "chunked_read", "host_transfer", "cache_populate", "admission",
-         "drain", "spill", "mv_refresh", "result_spool")
+         "drain", "spill", "mv_refresh", "result_spool", "autopilot")
 
 
 class FaultInjected(TransientError):

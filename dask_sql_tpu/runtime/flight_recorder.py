@@ -354,17 +354,19 @@ def record_query(report, error: Optional[BaseException] = None) -> None:
     # boundary this query produced — all bytes the engine actually touched
     # and the broker would have had to host concurrently
     measured = int(report.bytes_out) + stage_bytes
+    cache_hit = bool(report.cache.get("hit"))
     rec = {
         "kind": "query",
         "unix": round(report.started_unix, 3),
         "pid": os.getpid(),
         "query": report.query.strip()[:500],
-        "outcome": "error" if error is not None else "ok",
+        "outcome": ("error" if error is not None
+                    else "cache_hit" if cache_hit else "ok"),
         "error": type(error).__name__ if error is not None else "",
         "wall_ms": round(report.wall_ms, 3),
         "tier": report.tier or "",
         "priority": report.priority or "",
-        "cache_hit": bool(report.cache.get("hit")),
+        "cache_hit": cache_hit,
         "cache_tier": report.cache.get("tier") or "",
         "cache_stored": bool(report.cache.get("stored")),
         "rows_out": int(report.rows_out),
@@ -402,9 +404,16 @@ def record_query(report, error: Optional[BaseException] = None) -> None:
     if rid:
         rec["replica"] = rid
     _append(path, rec)
-    if plan_fp and error is None and measured > 0:
-        _observe_stat(plan_fp, nbytes=measured, rows=report.rows_out,
-                      ms=report.wall_ms)
+    if plan_fp and error is None:
+        if cache_hit:
+            # a cache hit bypassed execution: bump the hit count ONLY, so
+            # hot queries keep accruing rank in system.view_candidates
+            # without folding a near-zero wall into the recompute-cost
+            # EWMA (which would crater score = n × ewma_ms)
+            _observe_stat(plan_fp)
+        elif measured > 0:
+            _observe_stat(plan_fp, nbytes=measured, rows=report.rows_out,
+                          ms=report.wall_ms)
 
 
 def record_stage(digest: str, rows_in: int, rows_out: int, capacity: int,

@@ -134,6 +134,41 @@ def drain_timeout_s() -> float:
 # class can accumulate, so one stale credit pile cannot monopolize a window
 _DEFICIT_CAP = 8.0 * sum(WEIGHTS.values())
 
+
+def tenant_weights() -> Dict[str, float]:
+    """``DSQL_TENANT_WEIGHTS="gold:8,default:1"`` parsed to a weight map;
+    empty when unset (fairness classes stay priority-only).  Weights clamp
+    to a small positive floor — a zero weight would starve the class
+    forever, which is what the deficit scheduler exists to prevent."""
+    raw = os.environ.get("DSQL_TENANT_WEIGHTS", "").strip()
+    if not raw:
+        return {}
+    out: Dict[str, float] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        try:
+            out[name.strip().lower()] = max(float(w), 0.01)
+        except ValueError:
+            continue
+    return out
+
+
+def _fairness_tenant() -> Optional[str]:
+    """The fairness-class tenant of THIS thread's query, or None when
+    ``DSQL_TENANT_WEIGHTS`` is unset (scheduling stays priority-keyed,
+    bit-for-bit the pre-weights behavior).  Untenanted queries fall into
+    the "default" class so a weighted tenant contends against SOMETHING."""
+    if not tenant_weights():
+        return None
+    try:
+        from . import tenancy as _ten
+        return (_ten.current_tenant() or "default").lower()
+    except Exception:  # pragma: no cover - tenancy is optional
+        return "default"
+
 # estimator: per-operator working-set multipliers over scanned input bytes.
 # Joins/windows buffer both sides plus outputs; aggregates/sorts roughly
 # double; unlisted operators pass input bytes through.
@@ -364,10 +399,14 @@ class Ticket:
 
     __slots__ = ("priority", "est_bytes", "reserved_bytes", "enqueued_at",
                  "admitted_at", "queued_ms", "admitted", "released",
-                 "backoff_s")
+                 "backoff_s", "tenant")
 
-    def __init__(self, priority: str, est_bytes: int, enqueued_at: float):
+    def __init__(self, priority: str, est_bytes: int, enqueued_at: float,
+                 tenant: Optional[str] = None):
         self.priority = priority
+        # fairness-class tenant (None unless DSQL_TENANT_WEIGHTS is set):
+        # the ticket queues under "priority@tenant" instead of "priority"
+        self.tenant = tenant
         self.est_bytes = est_bytes
         self.reserved_bytes = 0
         self.enqueued_at = enqueued_at
@@ -457,6 +496,11 @@ class WorkloadManager:
         self._cv = threading.Condition(self._lock)
         self._running = 0
         self._seats = 0
+        # fairness classes: keyed by priority alone until
+        # DSQL_TENANT_WEIGHTS arms, then "priority@tenant" keys appear on
+        # demand (bounded: one per priority x tenant ever seen); with the
+        # knob unset the keys ARE exactly PRIORITIES and every code path
+        # below reduces to the pre-weights behavior bit-for-bit
         self._waiting: Dict[str, "deque[Ticket]"] = {
             p: deque() for p in PRIORITIES}
         self._deficit: Dict[str, float] = {p: 0.0 for p in PRIORITIES}
@@ -543,13 +587,21 @@ class WorkloadManager:
 
     def waiting_snapshot(self) -> "List[dict]":
         """Per-ticket view of the admission queue (system.active /
-        GET /v1/engine): priority class, time waited, requested bytes."""
+        GET /v1/engine): priority class, time waited, requested bytes
+        (plus the fairness tenant when weighted classes are armed)."""
         now = time.monotonic()
+        out: List[dict] = []
         with self._lock:
-            return [{"priority": p,
-                     "waitedMillis": round((now - t.enqueued_at) * 1e3, 1),
-                     "estBytes": int(t.est_bytes)}
-                    for p in PRIORITIES for t in self._waiting[p]]
+            for q in self._waiting.values():
+                for t in q:
+                    row = {"priority": t.priority,
+                           "waitedMillis": round(
+                               (now - t.enqueued_at) * 1e3, 1),
+                           "estBytes": int(t.est_bytes)}
+                    if t.tenant:
+                        row["tenant"] = t.tenant
+                    out.append(row)
+        return out
 
     # -- burn-driven load shedding (ISSUE 17) -------------------------------
     def _check_shed(self, priority: str) -> None:
@@ -644,17 +696,32 @@ class WorkloadManager:
         """
         _faults.maybe_fail("admission")
         priority = normalize_priority(priority)
+        # weighted tenant fairness (DSQL_TENANT_WEIGHTS): resolve the
+        # fairness class once, and keep per-tenant books on THIS path so
+        # submitted == admitted + rejected + timeout holds per tenant
+        # (claim_seat rejections happen before acquire and are out of
+        # these books by construction)
+        ften = _fairness_tenant()
+        if ften:
+            _tel.inc(f"sched_submitted_tenant_{ften}")
         if self.draining():
             _tel.inc(f"sched_rejected_{priority}")
+            if ften:
+                _tel.inc(f"sched_rejected_tenant_{ften}")
             raise self._drain_verdict()
         if seat is None:
             # server-submitted queries were already shed-checked at seat
             # claim time; checking their pre-claimed seat again here would
             # double-count the reject counters for one submission
-            self._check_shed(priority)
+            try:
+                self._check_shed(priority)
+            except Exception:
+                if ften:
+                    _tel.inc(f"sched_rejected_tenant_{ften}")
+                raise
         enqueued_at = seat.enqueued_at if seat is not None else \
             time.monotonic()
-        ticket = Ticket(priority, int(est_bytes), enqueued_at)
+        ticket = Ticket(priority, int(est_bytes), enqueued_at, tenant=ften)
         with self._cv:
             if seat is not None:
                 self._consume_seat_locked(seat)
@@ -662,6 +729,8 @@ class WorkloadManager:
             n_wait = self._waiting_count_locked()
             if self._running >= limit and n_wait >= depth:
                 _tel.inc(f"sched_rejected_{priority}")
+                if ften:
+                    _tel.inc(f"sched_rejected_tenant_{ften}")
                 self._publish_locked()
                 raise AdmissionRejected(
                     f"admission queue full ({n_wait} waiting >= depth "
@@ -675,13 +744,18 @@ class WorkloadManager:
                 if (rem is not None and expected is not None
                         and rem < expected * 0.5):
                     _tel.inc(f"sched_rejected_{priority}")
+                    if ften:
+                        _tel.inc(f"sched_rejected_tenant_{ften}")
                     self._publish_locked()
                     raise AdmissionRejected(
                         f"deadline would expire while queued "
                         f"({rem * 1e3:.0f} ms left, ~{expected * 1e3:.0f} "
                         f"ms expected wait)",
                         retry_after_s=self._retry_after_locked())
-            self._waiting[priority].append(ticket)
+            key = self._class_key(ticket)
+            self._waiting.setdefault(key, deque())
+            self._deficit.setdefault(key, 0.0)
+            self._waiting[key].append(ticket)
             self._publish_locked()
             self._dispatch_locked()
             give_up = (time.monotonic() + self.queue_timeout_s()
@@ -706,6 +780,8 @@ class WorkloadManager:
                     # cancellation — counts into the timeout family so
                     # admitted + rejected + timeout == submitted, always
                     _tel.inc(f"sched_timeout_{priority}")
+                    if ften:
+                        _tel.inc(f"sched_timeout_tenant_{ften}")
                 self._publish_locked()
                 raise
         _tls.last_queued_ms = ticket.queued_ms
@@ -719,13 +795,29 @@ class WorkloadManager:
             self._publish_locked()
 
     # -- internals (condition lock held) ------------------------------------
+    @staticmethod
+    def _class_key(ticket: Ticket) -> str:
+        return (f"{ticket.priority}@{ticket.tenant}" if ticket.tenant
+                else ticket.priority)
+
+    @staticmethod
+    def _weight_of(key: str) -> float:
+        """DWRR weight of a fairness class: the priority weight alone for
+        plain keys, x the tenant weight for "priority@tenant" keys (an
+        unlisted tenant inherits the "default" entry, else 1.0)."""
+        if "@" in key:
+            p, _, t = key.partition("@")
+            tw = tenant_weights()
+            return WEIGHTS[p] * tw.get(t, tw.get("default", 1.0))
+        return WEIGHTS[key]
+
     def _waiting_count_locked(self) -> int:
         return sum(len(q) for q in self._waiting.values())
 
     def _abandon_locked(self, ticket: Ticket) -> None:
         try:
-            self._waiting[ticket.priority].remove(ticket)
-        except ValueError:  # pragma: no cover - double abandon
+            self._waiting[self._class_key(ticket)].remove(ticket)
+        except (KeyError, ValueError):  # pragma: no cover - double abandon
             pass
 
     def _expected_wait_locked(self, n_ahead: int) -> Optional[float]:
@@ -746,50 +838,61 @@ class WorkloadManager:
         """Deficit-weighted round-robin with aging: every non-empty class
         gains its weight; the winner (highest deficit + aging boost) pays
         the round's total, so service converges to the weight ratio and an
-        unserved class accumulates credit until it must win."""
-        active = [p for p in PRIORITIES if self._waiting[p]]
+        unserved class accumulates credit until it must win.  With tenant
+        weights armed the classes are "priority@tenant" and the weight is
+        the product, so a noisy tenant's flood cannot starve a quiet
+        tenant even inside one priority band; unarmed, the keys are
+        exactly PRIORITIES and this is the pre-weights loop unchanged
+        (the computed cap equals _DEFICIT_CAP)."""
+        active = [k for k in self._waiting if self._waiting[k]]
         if not active:
             return None
-        for p in active:
-            self._deficit[p] = min(self._deficit[p] + WEIGHTS[p],
-                                   _DEFICIT_CAP)
+        cap = 8.0 * sum(self._weight_of(k) for k in self._waiting)
+        for k in active:
+            self._deficit[k] = min(self._deficit[k] + self._weight_of(k),
+                                   cap)
         aging = self.aging_ms()
         now = time.monotonic()
 
-        def score(p: str) -> float:
-            head = self._waiting[p][0]
+        def score(k: str) -> float:
+            head = self._waiting[k][0]
             waited_ms = (now - head.enqueued_at) * 1e3
             boost = waited_ms / aging if aging > 0 else 0.0
-            return self._deficit[p] + boost
+            return self._deficit[k] + boost
 
         best = max(active, key=score)
-        self._deficit[best] -= sum(WEIGHTS[p] for p in active)
+        self._deficit[best] -= sum(self._weight_of(k) for k in active)
         return best
 
     def _dispatch_locked(self) -> None:
         limit = self.limit()
         while self._running < limit:
-            p = self._pick_locked()
-            if p is None:
+            k = self._pick_locked()
+            if k is None:
                 break
-            ticket = self._waiting[p][0]
+            ticket = self._waiting[k][0]
             reserved = self.ledger.reserve(ticket.est_bytes)
             if reserved is None:
                 # over-reservation queues rather than crashes: refund the
                 # round's deficit charge and retry at the next release
-                self._deficit[p] += sum(
-                    WEIGHTS[q] for q in PRIORITIES if self._waiting[q])
+                self._deficit[k] += sum(
+                    self._weight_of(q) for q in self._waiting
+                    if self._waiting[q])
                 break
-            self._waiting[p].popleft()
-            if not self._waiting[p]:
-                self._deficit[p] = 0.0   # classic DRR: empty queue resets
+            self._waiting[k].popleft()
+            if not self._waiting[k]:
+                self._deficit[k] = 0.0   # classic DRR: empty queue resets
             ticket.reserved_bytes = reserved
             ticket.admitted = True
             ticket.admitted_at = time.monotonic()
             ticket.queued_ms = (ticket.admitted_at
                                 - ticket.enqueued_at) * 1e3
             self._running += 1
-            _tel.inc(f"sched_admitted_{p}")
+            # counters stay PRIORITY-keyed (the chaos-soak reconciliation
+            # invariant sums over PRIORITIES), with per-tenant books added
+            _tel.inc(f"sched_admitted_{ticket.priority}")
+            if ticket.tenant:
+                _tel.inc(f"sched_admitted_tenant_{ticket.tenant}")
             self._cv.notify_all()
         self._publish_locked()
 

@@ -528,6 +528,14 @@ def groupby_decision(rel, context) -> Tuple[str, Dict[str, Any]]:
     if forced is not None:
         info["forced"] = 1
         return forced, info
+    if os.environ.get("DSQL_AUTOPILOT", "0").strip() not in ("", "0"):
+        # an autopilot re-plan hint for this fingerprint overrides the
+        # crossover (but never a forced pin); env checked before import
+        from . import autopilot as _ap
+        hinted = _ap.current_hint("groupby")
+        if hinted in ("hash", "sorted", "dense"):
+            info["autopilot"] = 1
+            return hinted, info
     if not adaptive_enabled() or not rel.group_keys:
         return "hash", info
     rows = estimate_rows(rel.input, context)

@@ -36,7 +36,7 @@ from ..table import Table
 TABLE_NAMES = ("queries", "active", "metrics", "cache", "quarantine",
                "programs", "table_stats", "mesh", "spill", "devices",
                "matviews", "view_candidates", "events", "slo", "prepared",
-               "tenants", "replicas")
+               "tenants", "replicas", "autopilot")
 
 
 def _fleet_on() -> bool:
@@ -523,6 +523,30 @@ def _replicas() -> Table:
     })
 
 
+def _autopilot() -> Table:
+    """The autopilot's action journal (runtime/autopilot.py): one row per
+    matview create/refresh/drop, re-plan hint record/verdict/revert, or
+    faulted tick, newest last.  Same env-gate-before-import discipline as
+    ``system.events`` — ``DSQL_AUTOPILOT=0`` yields the fixed empty
+    schema and the module stays un-imported."""
+    import os
+
+    rows: List[dict] = []
+    if os.environ.get("DSQL_AUTOPILOT", "0").strip() not in ("", "0"):
+        from . import autopilot as _ap
+
+        rows = _ap.journal_rows()
+    return Table.from_pydict({
+        "unix": _col(rows, "unix", np.float64, 0.0),
+        "action": _col(rows, "action", object, ""),
+        "trigger": _col(rows, "trigger", object, ""),
+        "fingerprint": _col(rows, "fingerprint", object, ""),
+        "verdict": _col(rows, "verdict", object, ""),
+        "bytes": _col(rows, "bytes", np.int64, 0),
+        "detail": _col(rows, "detail", object, ""),
+    })
+
+
 _BUILDERS: Dict[str, object] = {
     "queries": _queries,
     "active": _active,
@@ -541,6 +565,7 @@ _BUILDERS: Dict[str, object] = {
     "prepared": _prepared,
     "tenants": _tenants,
     "replicas": _replicas,
+    "autopilot": _autopilot,
 }
 
 #: builders that need the resolving context (catalog / mesh live there)

@@ -189,6 +189,15 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     # written / beat failures swallowed, and merged-ring reads served
     # (system.events fleet mode, /v1/events?fleet=1, /v1/fleet)
     "fleet_heartbeats", "fleet_heartbeat_errors", "fleet_merged_reads",
+    # autopilot (runtime/autopilot.py, DSQL_AUTOPILOT=1): advisor ticks,
+    # matview actuator actions (auto-create / drop / background refresh /
+    # exact-repeat serves), and the re-planning loop's hint lifecycle
+    # (recorded on a tripped threshold, applied to an execution, reverted
+    # after two measured-slower strikes)
+    "autopilot_ticks", "autopilot_mv_creates", "autopilot_mv_drops",
+    "autopilot_mv_refreshes", "autopilot_mv_serves",
+    "autopilot_hints_recorded", "autopilot_hints_applied",
+    "autopilot_hints_reverted",
 )
 
 STABLE_HISTOGRAMS: Tuple[str, ...] = (
@@ -937,6 +946,15 @@ def _close_trace(trace: QueryTrace, error: Optional[BaseException]) -> None:
             _ev.on_query_complete(report, error)
         except Exception:
             logger.debug("event hook failed", exc_info=True)
+
+    # autopilot feedback (runtime/autopilot.py): hinted-run verdicts and
+    # threshold-tripped hint recording — same env-gate-before-import
+    if os.environ.get("DSQL_AUTOPILOT", "0").strip() not in ("", "0"):
+        try:
+            from . import autopilot as _ap
+            _ap.on_query_complete(report, error)
+        except Exception:
+            logger.debug("autopilot hook failed", exc_info=True)
 
 
 @contextmanager

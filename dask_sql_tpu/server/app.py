@@ -570,6 +570,12 @@ def _engine_snapshot(state: "_AppState") -> dict:
         from ..runtime import fleet as _fleet
         out["fleet"] = {"replica": _fleet.replica_id(),
                         "dir": _fleet.fleet_dir() or ""}
+    if os.environ.get("DSQL_AUTOPILOT", "0").strip() not in ("", "0"):
+        try:
+            from ..runtime import autopilot as _ap
+            out["autopilot"] = _ap.engine_section()
+        except Exception:
+            logger.debug("autopilot engine section failed", exc_info=True)
     return out
 
 

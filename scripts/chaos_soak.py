@@ -176,8 +176,18 @@ def main(argv=None) -> int:
     qdir = tempfile.mkdtemp(prefix="dsql_chaos_")
     os.environ["DSQL_QUARANTINE_FILE"] = os.path.join(qdir, "quarantine.json")
     os.environ["DSQL_QUARANTINE_TTL_S"] = "5"      # let probes happen in-soak
+    # autopilot armed for the whole soak: the advisor ticks under the same
+    # fault stream as the clients (the ``autopilot`` site degrades a tick
+    # to a journaled no-op), auto-materializes whatever the mixed workload
+    # makes hot, and re-plans skewed joins — all while every client below
+    # keeps asserting pandas-oracle answers
+    os.environ["DSQL_HISTORY_FILE"] = os.path.join(qdir, "history.jsonl")
+    os.environ["DSQL_AUTOPILOT"] = "1"
+    os.environ["DSQL_AUTOPILOT_INTERVAL_S"] = "0"  # the client ticks
+    os.environ["DSQL_AUTOPILOT_MIN_HITS"] = "3"
 
     from dask_sql_tpu import Context
+    from dask_sql_tpu.runtime import autopilot as autopilot_mod
     from dask_sql_tpu.runtime import faults
     from dask_sql_tpu.runtime import resilience as res
     from dask_sql_tpu.runtime import scheduler as sched
@@ -201,6 +211,12 @@ def main(argv=None) -> int:
     ctx.create_table("tm", tm)
     ctx.sql("CREATE MATERIALIZED VIEW vm AS "
             "SELECT k, SUM(v) AS s, COUNT(*) AS n FROM tm GROUP BY k")
+
+    # the autopilot client's private base: ITS aggregate goes hot so the
+    # advisor auto-materializes it mid-soak, and appends force O(delta)
+    # refreshes on the serve path
+    ta = t1[["k", "v"]].copy()
+    ctx.create_table("ta", ta)
 
     # probabilistic faults on EVERY site, deterministic per-site streams,
     # plus a rare FATAL compile fault (exile + quarantine coverage)
@@ -305,6 +321,55 @@ def main(argv=None) -> int:
             with lock:
                 stats["ok"] += 1
 
+    def autopilot_client() -> None:
+        # repeats ONE aggregate shape so the advisor sees a hot candidate,
+        # appends occasionally so serves must refresh O(delta), and ticks
+        # the advisor explicitly under the same fault stream as everything
+        # else — the loop may stall (tick_fault), never corrupt an answer
+        rng = random.Random(args.seed * 1000 + 8888)
+        oracle = ta.copy()
+        sql = "SELECT k, SUM(v) AS s, COUNT(*) AS n FROM ta GROUP BY k"
+        while time.monotonic() < t_end:
+            autopilot_mod.tick(ctx)
+            if rng.random() < 0.3:
+                add = pd.DataFrame({
+                    "k": [rng.randrange(20) for _ in range(8)],
+                    "v": [round(rng.random() * 10, 3) for _ in range(8)],
+                })
+                ctx.append_rows("ta", add)
+                oracle = pd.concat([oracle, add], ignore_index=True)
+                continue
+            expected = oracle.groupby("k", as_index=False).agg(
+                s=("v", "sum"), n=("v", "size"))
+            pr = PRIORITIES[rng.randrange(len(PRIORITIES))]
+            with lock:
+                stats["submitted"] += 1
+            try:
+                got = ctx.sql(sql, return_futures=False,
+                              timeout=QUERY_TIMEOUT_S, priority=pr)
+            except res.ResilienceError:
+                with lock:
+                    stats["typed"] += 1
+                continue
+            except Exception as e:  # noqa: BLE001 - the gate records it
+                with lock:
+                    stats["untyped"] += 1
+                    problems.append(f"untyped {type(e).__name__} on the "
+                                    f"autopilot-managed read: {e}")
+                continue
+            try:
+                pd.testing.assert_frame_equal(
+                    _norm(got), _norm(expected), check_dtype=False,
+                    rtol=1e-6, atol=1e-9)
+            except AssertionError as e:
+                with lock:
+                    stats["wrong"] += 1
+                    problems.append("WRONG RESULT on the autopilot-managed "
+                                    f"read (stale serve?): {str(e)[:300]}")
+                continue
+            with lock:
+                stats["ok"] += 1
+
     def paging_client() -> None:
         # the wire-level tenant: pages 2000-row results through the spool
         # and walks away from half of them mid-chain (disconnect), leaving
@@ -394,6 +459,7 @@ def main(argv=None) -> int:
     threads = [threading.Thread(target=client, args=(i,), daemon=True)
                for i in range(args.clients)]
     threads.append(threading.Thread(target=mv_client, daemon=True))
+    threads.append(threading.Thread(target=autopilot_client, daemon=True))
     threads.append(threading.Thread(target=paging_client, daemon=True))
     for th in threads:
         th.start()
@@ -515,7 +581,13 @@ def main(argv=None) -> int:
                    "quarantine_marks", "exiled", "deadline_exceeded",
                    "result_cache_hits", "mv_serves",
                    "mv_refresh_incremental", "mv_refresh_full",
-                   "mv_deltas_recorded")
+                   "mv_deltas_recorded", "autopilot_ticks",
+                   "autopilot_mv_creates", "autopilot_mv_drops",
+                   "autopilot_mv_serves", "autopilot_hints_recorded",
+                   "autopilot_hints_applied", "autopilot_hints_reverted")
+    if d("autopilot_ticks") == 0 and d("fault_autopilot") == 0:
+        failures.append("the autopilot was never ticked — the advisor "
+                        "was not exercised by the soak")
     fault_counts = {k: d(k) for k in c1 if k.startswith("fault_") and d(k)}
     print(f"chaos soak: {stats['submitted']} submitted over "
           f"{args.budget_s:.0f} s x {args.clients} clients (p={args.p}) -> "

@@ -138,6 +138,15 @@ echo "=== [2q] fleet obs smoke (replica registry + shared warmth) ==="
 # label-free baseline wire exactly (fleet module never imported)
 python scripts/fleet_obs_smoke.py
 
+echo "=== [2r] autopilot smoke (closed loop: watchtower -> optimizer) ==="
+# a shifting workload must converge unattended: the top view candidate
+# auto-materialized within 3 queries and served oracle-exact across an
+# append, the cold view dropped with its budget freed, a skewed grace
+# join re-planned via a journaled hint that measures faster on the next
+# run, everything visible in system.autopilot, and DSQL_AUTOPILOT=0 a
+# bit-for-bit silent baseline
+python scripts/autopilot_smoke.py
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \

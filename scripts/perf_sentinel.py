@@ -50,7 +50,7 @@ NO_INCREASE = ("compile_errors",)
 # headline fields shown as context but NEVER gated on: the watchtower's
 # per-class SLO attainment depends on the burst pass's load shape, so a
 # band would flap — operators read the trend, the sentinel only displays
-INFORMATIONAL = ("slo_attainment",)
+INFORMATIONAL = ("slo_attainment", "autopilot_vs_tuned_geomean")
 
 # the wall-clock metric name bench.py has emitted since PR 6; artifacts
 # with a different ``metric`` (r01's rows/sec era) contribute no

@@ -199,6 +199,28 @@ def _events_off(request, monkeypatch):
 
 
 @pytest.fixture(autouse=True)
+def _autopilot_off(request, monkeypatch):
+    """The autopilot (runtime/autopilot.py) is env-armed like the events
+    bus; an operator's DSQL_AUTOPILOT must not arm matview creation or
+    plan-hint rewrites in unrelated suites (or break the zero-import
+    tripwire test), and DSQL_TENANT_WEIGHTS must not split the scheduler's
+    fairness classes per tenant under pre-existing counter assertions.
+    Off by default, armed explicitly by the dedicated autopilot suites,
+    and scripts/autopilot_smoke.py gates the production path."""
+    name = request.module.__name__
+    if "autopilot" not in name:
+        monkeypatch.delenv("DSQL_AUTOPILOT", raising=False)
+        for _k in ("DSQL_AUTOPILOT_MV_MB", "DSQL_AUTOPILOT_SKEW",
+                   "DSQL_AUTOPILOT_COST_ERR", "DSQL_AUTOPILOT_COLD_S",
+                   "DSQL_AUTOPILOT_INTERVAL_S", "DSQL_AUTOPILOT_MIN_HITS",
+                   "DSQL_AUTOPILOT_FILE"):
+            monkeypatch.delenv(_k, raising=False)
+    if "autopilot" not in name and "scheduler" not in name:
+        monkeypatch.delenv("DSQL_TENANT_WEIGHTS", raising=False)
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _mesh_off(request, monkeypatch):
     """The SPMD multi-chip backend (parallel/spmd.py, on by default when a
     context carries a mesh) intercepts mesh-context queries before the

@@ -1,0 +1,429 @@
+"""Unit tests for runtime/autopilot.py: matview budget accounting,
+cold-view drop, hint record/apply/two-strike revert, the fault site, the
+kill switch, the zero-import tripwire, the cache-hit candidate envelope
+(ranking survives a warm cache), and the DSQL_TENANT_WEIGHTS fairness
+classes in the scheduler."""
+import os
+import subprocess
+import sys
+import time
+
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu.runtime import faults
+from dask_sql_tpu.runtime import flight_recorder as fr
+from dask_sql_tpu.runtime import matview as mv
+from dask_sql_tpu.runtime import scheduler as sched
+from dask_sql_tpu.runtime import telemetry as tel
+from dask_sql_tpu.runtime import tenancy
+
+
+@pytest.fixture()
+def ap_env(tmp_path, monkeypatch):
+    """Armed autopilot with an explicit-tick-only daemon and a tmp
+    history ring (candidates come from the flight recorder)."""
+    monkeypatch.setenv("DSQL_AUTOPILOT", "1")
+    monkeypatch.setenv("DSQL_AUTOPILOT_INTERVAL_S", "0")   # no daemon
+    monkeypatch.setenv("DSQL_AUTOPILOT_MIN_HITS", "2")
+    monkeypatch.setenv("DSQL_HISTORY_FILE", str(tmp_path / "hist.jsonl"))
+    monkeypatch.setenv("DSQL_RESULT_CACHE_MB", "64")
+    from dask_sql_tpu.runtime import autopilot as ap
+    ap._reset_for_tests()
+    yield ap
+    ap._reset_for_tests()
+
+
+@pytest.fixture()
+def ctx():
+    c = Context()
+    c.create_table("t", pd.DataFrame(
+        {"a": [1, 2, 3, 1, 2, 3] * 20, "b": [float(i) for i in range(120)]}))
+    yield c
+
+
+def _warm(ctx, sql, n):
+    for _ in range(n):
+        ctx.sql(sql).to_pandas()
+
+
+# ---------------------------------------------------------------------------
+# stub reports for the feedback hook (shape mirrors telemetry.QueryReport)
+# ---------------------------------------------------------------------------
+
+class _Span:
+    def __init__(self, name="query", attrs=None, children=()):
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.children = list(children)
+
+    def walk(self):
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+
+class _Report:
+    def __init__(self, fp, wall_ms, *, hinted=False, operators=(),
+                 skew=None, cerr=None, partitions=None, cache_hit=False):
+        attrs = {"plan_fp": fp}
+        if hinted:
+            attrs["autopilot_hinted"] = 1
+        kids = []
+        if partitions:
+            kids.append(_Span("grace_join", {"partitions": partitions}))
+        self.root = _Span("query", attrs, kids)
+        self.wall_ms = float(wall_ms)
+        self.cache = {"hit": cache_hit}
+        self.operators = list(operators)
+        self.skew_ratio = skew
+        self.cost_err = cerr
+        self.rows_out = 0
+
+
+# ---------------------------------------------------------------------------
+# kill switch + fault site
+# ---------------------------------------------------------------------------
+
+def test_kill_switch_tick_is_noop(ap_env, ctx, monkeypatch):
+    monkeypatch.setenv("DSQL_AUTOPILOT", "0")
+    assert not ap_env.enabled()
+    assert ap_env.tick(ctx) == {}
+    assert ap_env.journal_rows() == []
+
+
+def test_fault_site_degrades_tick_to_journaled_noop(ap_env, ctx):
+    before = tel.REGISTRY.get("fault_autopilot") or 0
+    with faults.inject("autopilot:1+"):
+        out = ap_env.tick(ctx)
+    assert out == {"faulted": True}
+    rows = ap_env.journal_rows()
+    assert rows and rows[-1]["action"] == "tick_fault"
+    assert (tel.REGISTRY.get("fault_autopilot") or 0) > before
+    # nothing was created, nothing is managed — pure no-op
+    assert ap_env.engine_section()["managedViews"] == []
+
+
+# ---------------------------------------------------------------------------
+# matview loop: create under budget, skip over budget, drop when cold
+# ---------------------------------------------------------------------------
+
+def test_tick_creates_top_candidate(ap_env, ctx):
+    _warm(ctx, "SELECT a, SUM(b) AS s FROM t GROUP BY a", 3)
+    before = tel.REGISTRY.get("autopilot_mv_creates")
+    out = ap_env.tick(ctx)
+    assert out["created"] == 1
+    assert tel.REGISTRY.get("autopilot_mv_creates") == before + 1
+    sec = ap_env.engine_section()
+    assert len(sec["managedViews"]) == 1
+    name = sec["managedViews"][0]
+    assert name.startswith("auto_mv_")
+    # the view is a real registry entry queryable by name
+    got = ctx.sql(f"SELECT * FROM {name}").to_pandas()
+    assert len(got) == 3
+    rows = ap_env.journal_rows()
+    assert any(r["action"] == "mv_create" and r["bytes"] > 0 for r in rows)
+    # a second tick must NOT re-create the same shape (managed-fp guard
+    # across the shape-mode/value-mode fingerprint duality)
+    assert ap_env.tick(ctx)["created"] == 0
+
+
+def test_system_autopilot_table(ap_env, ctx):
+    _warm(ctx, "SELECT a, SUM(b) AS s FROM t GROUP BY a", 3)
+    ap_env.tick(ctx)
+    got = ctx.sql(
+        "SELECT action, fingerprint, bytes FROM system.autopilot"
+    ).to_pandas()
+    assert "mv_create" in set(got["action"])
+    row = got[got["action"] == "mv_create"].iloc[0]
+    assert row["fingerprint"] and row["bytes"] > 0
+
+
+def test_budget_accounting(ap_env, ctx, monkeypatch):
+    _warm(ctx, "SELECT a, SUM(b) AS s FROM t GROUP BY a", 3)
+    # a zero budget: the estimated state bytes exceed it -> skip, journal
+    monkeypatch.setenv("DSQL_AUTOPILOT_MV_MB", "0")
+    out = ap_env.tick(ctx)
+    assert out["created"] == 0
+    rows = ap_env.journal_rows()
+    assert any(r["action"] == "mv_skip" and r["trigger"] == "budget"
+               for r in rows)
+    assert ap_env.engine_section()["mvUsedBytes"] == 0
+    # budget restored: the same candidate materializes and the used-bytes
+    # ledger stays within budget
+    monkeypatch.setenv("DSQL_AUTOPILOT_MV_MB", "64")
+    assert ap_env.tick(ctx)["created"] == 1
+    sec = ap_env.engine_section()
+    assert 0 < sec["mvUsedBytes"] <= sec["mvBudgetBytes"]
+
+
+def test_cold_view_drop_and_serve_keeps_warm(ap_env, ctx):
+    _warm(ctx, "SELECT a, SUM(b) AS s FROM t GROUP BY a", 3)
+    now = time.time()
+    assert ap_env.tick(ctx, now=now)["created"] == 1
+    name = ap_env.engine_section()["managedViews"][0]
+    schema = ctx.schema_name
+    # a serve advances the warmth clock: not cold at +400s
+    reg = mv.get_registry(ctx)
+    reg.views[(schema, name)].serves += 1
+    assert ap_env.tick(ctx, now=now + 400)["dropped"] == 0
+    assert name in ap_env.engine_section()["managedViews"]
+    # no further serves: cold at +800s -> dropped, books settled
+    before = tel.REGISTRY.get("autopilot_mv_drops")
+    out = ap_env.tick(ctx, now=now + 800)
+    assert out["dropped"] == 1
+    assert tel.REGISTRY.get("autopilot_mv_drops") == before + 1
+    assert ap_env.engine_section()["managedViews"] == []
+    assert ap_env.engine_section()["mvUsedBytes"] == 0
+    assert name not in ctx.schema[schema].tables
+    rows = ap_env.journal_rows()
+    drop = [r for r in rows if r["action"] == "mv_drop"]
+    assert drop and drop[-1]["bytes"] > 0
+
+
+def test_unparseable_candidate_blacklisted_once(ap_env, ctx):
+    fp = "deadbeef" * 8
+    fr._observe_stat(fp, nbytes=1024, rows=10, ms=50.0)
+    fr._observe_stat(fp, nbytes=1024, rows=10, ms=50.0)
+    fr._append(fr.history_path(),
+               {"kind": "query", "plan_fp": fp, "query": "NOT REAL SQL ("})
+    assert ap_env.tick(ctx)["created"] == 0
+    rows = [r for r in ap_env.journal_rows() if r["action"] == "mv_reject"]
+    assert len(rows) == 1 and rows[0]["fingerprint"] == fp
+    # the blacklist holds: no second reject for the same fingerprint
+    ap_env.tick(ctx)
+    rows = [r for r in ap_env.journal_rows() if r["action"] == "mv_reject"]
+    assert len(rows) == 1
+
+
+# ---------------------------------------------------------------------------
+# cache-hit candidate envelope: ranking survives a warm cache
+# ---------------------------------------------------------------------------
+
+def test_candidate_hits_accrue_through_warm_cache(ap_env, ctx):
+    """A result-cache hit used to record NOTHING, so a warm cache starved
+    system.view_candidates of exactly the queries most worth
+    materializing.  Hits now accrue through a lightweight count-only
+    envelope (outcome="cache_hit", zero device ms)."""
+    sql = "SELECT a, SUM(b) AS s FROM t GROUP BY a"
+    ctx.sql(sql).to_pandas()                      # miss: full envelope
+    cands = mv.view_candidate_rows(ctx)
+    assert cands and cands[0]["hits"] == 1
+    fp = cands[0]["fingerprint"]
+    ewma_before = cands[0]["ewma_ms"]
+    _warm(ctx, sql, 2)                            # warm: cache hits
+    events = fr.read_events(kind="query")
+    assert [e["outcome"] for e in events[-2:]] == ["cache_hit", "cache_hit"]
+    cands = {c["fingerprint"]: c for c in mv.view_candidate_rows(ctx)}
+    assert cands[fp]["hits"] == 3
+    # count-only accrual: the near-zero served-from-memory wall must not
+    # crater the recompute-cost term of the ranking score
+    assert cands[fp]["ewma_ms"] == pytest.approx(ewma_before)
+    assert cands[fp]["score"] == pytest.approx(3 * ewma_before)
+
+
+# ---------------------------------------------------------------------------
+# adaptive re-planning: record, apply, judge, two-strike revert
+# ---------------------------------------------------------------------------
+
+def test_hint_record_flips_measured_decisions(ap_env, ctx):
+    before = tel.REGISTRY.get("autopilot_hints_recorded")
+    rep = _Report("fp-skew", 100.0, skew=5.0, partitions=8,
+                  operators=["spmd_join=broadcast build=left rows=10",
+                             "groupby=hash rows=10 ndv=3"])
+    ap_env.on_query_complete(rep)
+    entry = ap_env.get_hint("fp-skew")
+    assert entry is not None and entry["state"] == "active"
+    assert entry["hints"] == {"join": "exchange", "groupby": "sorted",
+                              "partitions": 16}
+    assert entry["baseline_ms"] == 100.0
+    assert tel.REGISTRY.get("autopilot_hints_recorded") == before + 1
+    rows = ap_env.journal_rows()
+    assert rows[-1]["action"] == "hint_record"
+    assert "skew_ratio=5" in rows[-1]["trigger"]
+
+
+def test_hint_below_threshold_records_nothing(ap_env):
+    ap_env.on_query_complete(
+        _Report("fp-ok", 100.0, skew=1.2, cerr=0.3,
+                operators=["groupby=hash rows=10"]))
+    assert ap_env.get_hint("fp-ok") is None
+
+
+def test_cache_hit_and_error_runs_are_not_samples(ap_env):
+    ap_env.on_query_complete(
+        _Report("fp-c", 1.0, skew=9.0, cache_hit=True,
+                operators=["groupby=hash rows=10"]))
+    assert ap_env.get_hint("fp-c") is None
+    ap_env.on_query_complete(
+        _Report("fp-e", 1.0, skew=9.0, operators=["groupby=hash rows=1"]),
+        error=RuntimeError("boom"))
+    assert ap_env.get_hint("fp-e") is None
+
+
+def test_hint_applies_to_next_execution(ap_env, ctx):
+    ap_env.on_query_complete(
+        _Report("fp-a", 100.0, skew=5.0, partitions=4))
+    before = tel.REGISTRY.get("autopilot_hints_applied")
+    ap_env.begin_query("fp-a", ctx)
+    try:
+        assert ap_env.current_hint("partitions") == 8
+        assert ap_env.current_hint("join") is None
+    finally:
+        ap_env.end_query()
+    assert ap_env.current_hint("partitions") is None
+    assert tel.REGISTRY.get("autopilot_hints_applied") == before + 1
+
+
+def test_two_strike_revert(ap_env, ctx):
+    ap_env.on_query_complete(_Report("fp-r", 100.0, skew=5.0, partitions=4))
+    # strike 1: a hinted run measurably slower than the 100ms baseline
+    ap_env.on_query_complete(_Report("fp-r", 150.0, hinted=True))
+    entry = ap_env.get_hint("fp-r")
+    assert entry["state"] == "active" and entry["strikes"] == 1
+    assert any(r["action"] == "hint_strike" for r in ap_env.journal_rows())
+    # a faster run resets the strikes — one bad sample is not a verdict
+    ap_env.on_query_complete(_Report("fp-r", 80.0, hinted=True))
+    entry = ap_env.get_hint("fp-r")
+    assert entry["strikes"] == 0 and entry["verdict"] == "faster"
+    assert entry["hinted_ms"] == 80.0
+    # two consecutive slower runs revert the hint permanently
+    before = tel.REGISTRY.get("autopilot_hints_reverted")
+    ap_env.on_query_complete(_Report("fp-r", 150.0, hinted=True))
+    ap_env.on_query_complete(_Report("fp-r", 150.0, hinted=True))
+    entry = ap_env.get_hint("fp-r")
+    assert entry["state"] == "reverted" and entry["strikes"] == 2
+    assert tel.REGISTRY.get("autopilot_hints_reverted") == before + 1
+    assert any(r["action"] == "hint_revert" for r in ap_env.journal_rows())
+    # a reverted hint never applies again
+    ap_env.begin_query("fp-r", ctx)
+    try:
+        assert ap_env.current_hint("partitions") is None
+    finally:
+        ap_env.end_query()
+    # ...and later samples leave the tombstone alone
+    ap_env.on_query_complete(_Report("fp-r", 500.0, skew=9.0, partitions=4))
+    assert ap_env.get_hint("fp-r")["state"] == "reverted"
+
+
+def test_hints_cross_process_via_file(ap_env, tmp_path, monkeypatch):
+    """The hint store follows the kvstore discipline: a second process
+    (fresh module state) sees the same active hint."""
+    ap_env.on_query_complete(_Report("fp-x", 100.0, skew=5.0, partitions=4))
+    path = ap_env.hints_path()
+    assert path and os.path.exists(path)
+    code = (
+        "from dask_sql_tpu.runtime import autopilot as ap\n"
+        "e = ap.get_hint('fp-x')\n"
+        "assert e and e['state'] == 'active' "
+        "and e['hints'] == {'partitions': 8}, e\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr.decode()
+
+
+def test_engine_section_shape(ap_env, ctx):
+    ap_env.on_query_complete(_Report("fp-s", 100.0, skew=5.0, partitions=4))
+    sec = ap_env.engine_section()
+    assert sec["enabled"] is True
+    assert sec["hintsActive"] == 1 and sec["hintsReverted"] == 0
+    assert sec["actions"] >= 1
+    assert sec["lastAction"]["action"] == "hint_record"
+    assert sec["mvBudgetBytes"] == 64 * 2**20
+
+
+# ---------------------------------------------------------------------------
+# the zero-import disabled path
+# ---------------------------------------------------------------------------
+
+def test_disabled_query_never_imports_autopilot():
+    """With DSQL_AUTOPILOT unset an end-to-end query must leave
+    runtime.autopilot out of sys.modules entirely — the tripwire that
+    keeps the kill switch bit-for-bit."""
+    code = (
+        "import sys\n"
+        "from dask_sql_tpu import Context\n"
+        "c = Context()\n"
+        "c.create_table('t', {'a': [1, 2, 3]})\n"
+        "assert c.sql('SELECT SUM(a) AS s FROM t').to_pylist() == [[6]]\n"
+        "assert 'dask_sql_tpu.runtime.autopilot' not in sys.modules, \\\n"
+        "    'disabled path imported the autopilot'\n"
+    )
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("DSQL_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr.decode()
+
+
+# ---------------------------------------------------------------------------
+# DSQL_TENANT_WEIGHTS: per-tenant fairness classes in the scheduler
+# ---------------------------------------------------------------------------
+
+def test_tenant_weights_parsing(monkeypatch):
+    monkeypatch.setenv("DSQL_TENANT_WEIGHTS", "Gold:8, default:1, bad,z:-3")
+    w = sched.tenant_weights()
+    assert w["gold"] == 8.0 and w["default"] == 1.0
+    assert "bad" not in w
+    assert w["z"] == 0.01          # clamped: zero/negative would starve
+    monkeypatch.delenv("DSQL_TENANT_WEIGHTS")
+    assert sched.tenant_weights() == {}
+    assert sched._fairness_tenant() is None
+
+
+def test_tenant_class_keys_and_weights(monkeypatch):
+    monkeypatch.setenv("DSQL_TENANT_WEIGHTS", "gold:8,default:1")
+    t_gold = sched.Ticket("interactive", 0, 0.0, tenant="gold")
+    t_plain = sched.Ticket("interactive", 0, 0.0)
+    assert sched.WorkloadManager._class_key(t_gold) == "interactive@gold"
+    assert sched.WorkloadManager._class_key(t_plain) == "interactive"
+    w = sched.WorkloadManager._weight_of
+    assert w("interactive@gold") == sched.WEIGHTS["interactive"] * 8.0
+    # an unlisted tenant inherits the "default" entry
+    assert w("batch@bronze") == sched.WEIGHTS["batch"] * 1.0
+    assert w("interactive") == sched.WEIGHTS["interactive"]
+
+
+def test_tenant_counters_reconcile(monkeypatch):
+    monkeypatch.setenv("DSQL_MAX_CONCURRENT_QUERIES", "1")
+    monkeypatch.setenv("DSQL_QUEUE_DEPTH", "0")
+    monkeypatch.setenv("DSQL_DEVICE_BUDGET_MB", "0")
+    monkeypatch.setenv("DSQL_TENANT_WEIGHTS", "gold:8,default:1")
+    mgr = sched.WorkloadManager()
+    names = [f"sched_{k}_tenant_gold"
+             for k in ("submitted", "admitted", "rejected", "timeout")]
+    before = {n: tel.REGISTRY.get(n) or 0 for n in names}
+    with tenancy.tenant_scope("gold"):
+        t = mgr.acquire("interactive", 0)
+        assert t.admitted
+        # zero queue depth: a second acquire rejects immediately
+        with pytest.raises(Exception):
+            mgr.acquire("interactive", 0)
+        mgr.release(t)
+    d = {n: (tel.REGISTRY.get(n) or 0) - before[n] for n in names}
+    assert d["sched_submitted_tenant_gold"] == 2
+    assert d["sched_admitted_tenant_gold"] == 1
+    assert d["sched_rejected_tenant_gold"] == 1
+    # per-tenant books balance: submitted == admitted + rejected + timeout
+    assert (d["sched_submitted_tenant_gold"]
+            == d["sched_admitted_tenant_gold"]
+            + d["sched_rejected_tenant_gold"]
+            + d["sched_timeout_tenant_gold"])
+    # the priority-keyed counters (the pre-existing contract) still moved
+    assert (tel.REGISTRY.get("sched_admitted_interactive") or 0) > 0
+
+
+def test_unarmed_tenant_keys_stay_priority_only(monkeypatch):
+    monkeypatch.delenv("DSQL_TENANT_WEIGHTS", raising=False)
+    monkeypatch.setenv("DSQL_MAX_CONCURRENT_QUERIES", "2")
+    mgr = sched.WorkloadManager()
+    with tenancy.tenant_scope("gold"):
+        t = mgr.acquire("interactive", 0)
+    assert t.tenant is None
+    assert sched.WorkloadManager._class_key(t) == "interactive"
+    mgr.release(t)
+    assert set(mgr._waiting) <= set(sched.PRIORITIES)
