@@ -435,6 +435,116 @@ def _stage_main():
         sys.stderr.flush()
         os._exit(0)
 
+    if os.environ.get("BENCH_INGEST_CHILD") == "1":
+        # CONTINUOUS-INGESTION mode (parent opts in with BENCH_INGEST=1):
+        # WAL-armed 500-row appends into lineitem interleaved with reads
+        # of a maintained aggregate view and a COUNT(DISTINCT) view —
+        # journals sustained appends/sec, read p50/p99 beside the writer,
+        # the max observed staleness (pending delta age + rows), and the
+        # served-vs-recomputed exactness verdict (runtime/ingest.py +
+        # runtime/delta.py).
+        import tempfile as _itmp
+
+        import pandas as _ipd
+
+        from dask_sql_tpu.runtime import telemetry as _itel
+
+        # maintained view state is a result-cache tenant (see the MV mode
+        # above), and the WAL dir arms the ingest write path lazily
+        os.environ["DSQL_RESULT_CACHE_MB"] = cache_mb if cache_mb else "256"
+        os.environ["DSQL_INGEST_DIR"] = _itmp.mkdtemp(
+            prefix="dsql_bench_ingest_")
+        ING_SQL = ("SELECT l_returnflag, l_linestatus, "
+                   "SUM(l_quantity) AS sum_qty, "
+                   "SUM(l_extendedprice) AS sum_price, COUNT(*) AS n "
+                   "FROM lineitem GROUP BY l_returnflag, l_linestatus")
+        CD_SQL = "SELECT COUNT(DISTINCT l_suppkey) AS nd FROM lineitem"
+
+        def _ing_match(a, b) -> bool:
+            try:
+                cols = list(a.columns)
+                _ipd.testing.assert_frame_equal(
+                    a.sort_values(cols).reset_index(drop=True),
+                    b.sort_values(cols).reset_index(drop=True),
+                    check_dtype=False, rtol=1e-6, atol=1e-6)
+                return True
+            except Exception:  # noqa: BLE001 - any mismatch is "no"
+                return False
+
+        rec_ing = {}
+        try:
+            li = _ipd.read_feather(os.path.join(
+                os.environ["BENCH_DATA_DIR"], "lineitem.feather"))
+            c.sql(f"CREATE MATERIALIZED VIEW bench_ing AS {ING_SQL}")
+            c.sql(f"CREATE MATERIALIZED VIEW bench_cd AS {CD_SQL}")
+            # warm-up: pay the one-time delta-plan compiles before timing
+            c.append_rows("lineitem", li.sample(n=500, random_state=5))
+            c.sql("SELECT * FROM bench_ing", return_futures=False)
+            c.sql("SELECT nd FROM bench_cd", return_futures=False)
+
+            c0i = _itel.REGISTRY.counters()
+            rounds = int(os.environ.get("BENCH_INGEST_ROUNDS", "30"))
+            batch_n = int(os.environ.get("BENCH_INGEST_BATCH", "500"))
+            append_sec = 0.0
+            appended = 0
+            lat_ms = []
+            stale_max = 0.0
+            pend_max = 0
+            for i in range(rounds):
+                if left() < 30:
+                    break
+                delta = li.sample(n=batch_n, random_state=100 + i)
+                t0i = time.perf_counter()
+                c.append_rows("lineitem", delta)
+                append_sec += time.perf_counter() - t0i
+                appended += batch_n
+                g = _itel.REGISTRY.gauges()
+                stale_max = max(stale_max,
+                                float(g.get("mv_staleness_s", 0.0)))
+                pend_max = max(pend_max, int(g.get("mv_pending_rows", 0)))
+                sql_r = ("SELECT * FROM bench_ing" if i % 2 == 0
+                         else "SELECT nd FROM bench_cd")
+                t0i = time.perf_counter()
+                c.sql(sql_r, return_futures=False)
+                lat_ms.append((time.perf_counter() - t0i) * 1e3)
+            served = c.sql("SELECT * FROM bench_ing", return_futures=False)
+            recomputed = c.sql(ING_SQL, return_futures=False)
+            c1i = _itel.REGISTRY.counters()
+
+            def dlti(k):
+                return int(c1i.get(k, 0) - c0i.get(k, 0))
+
+            lat_ms.sort()
+
+            def pct(p):
+                if not lat_ms:
+                    return None
+                return round(lat_ms[min(int(len(lat_ms) * p),
+                                        len(lat_ms) - 1)], 2)
+
+            rec_ing = {
+                "batches": dlti("ingest_batches_committed"),
+                "rows_appended": appended,
+                "appends_per_sec": round(
+                    appended / max(append_sec, 1e-9), 1),
+                "read_p50_ms": pct(0.50),
+                "read_p99_ms": pct(0.99),
+                "staleness_max_s": round(stale_max, 3),
+                "pending_rows_max": pend_max,
+                "wal_bytes": int(_itel.REGISTRY.gauges().get(
+                    "ingest_wal_bytes", 0)),
+                "backpressure_rejects": dlti("ingest_backpressure_rejects"),
+                "mv_refresh_incremental": dlti("mv_refresh_incremental"),
+                "mv_refresh_full": dlti("mv_refresh_full"),
+                "match": _ing_match(served, recomputed),
+            }
+        except Exception as e:
+            rec_ing = {"error": repr(e)[:300]}
+        emit({"ingest": rec_ing})
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
+
     if os.environ.get("BENCH_AUTOPILOT_CHILD") == "1":
         # AUTOPILOT mode (parent opts in with BENCH_AUTOPILOT=1): the
         # unattended-vs-hand-tuned comparison.  A hand-tuned operator
@@ -1228,6 +1338,7 @@ def main():
         mv_evidence = None
         autopilot_evidence = None
         fleet_evidence = None
+        ingest_evidence = None
         load_sec = warmup_sec = 0.0
         try:
             with open(state["progress"]) as f:
@@ -1287,6 +1398,8 @@ def main():
                         autopilot_evidence = rec["autopilot"] or None
                     elif "fleet" in rec:
                         fleet_evidence = rec["fleet"] or None
+                    elif "ingest" in rec:
+                        ingest_evidence = rec["ingest"] or None
                     elif "slo_attainment" in rec:
                         slo_att = rec["slo_attainment"] or None
                     elif "param_mix" in rec:
@@ -1481,6 +1594,11 @@ def main():
                     # per-tenant SLO attainment, replica B's zero-compile
                     # warm serves, and the fleet plan-cache hit rate
                     "fleet": fleet_evidence,
+                    # continuous-ingestion evidence (runtime/ingest.py,
+                    # BENCH_INGEST=1): WAL-armed appends beside maintained
+                    # view reads — appends/sec, read p50/p99, the max
+                    # observed staleness, and the exactness verdict
+                    "ingest": ingest_evidence,
                     "program_store_hit_rate": (
                         round(restart_info["program_store_hits"]
                               / max(restart_info["program_store_hits"]
@@ -1929,6 +2047,30 @@ def main():
             proc.kill()
             proc.communicate()  # reap
             state["stage_meta"].append({"attempt": "fleet",
+                                        "error": "timeout"})
+        finally:
+            state["child"] = None
+
+    # CONTINUOUS-INGESTION pass (opt-in: BENCH_INGEST=1): WAL-armed
+    # appends interleaved with maintained-view reads — journals sustained
+    # appends/sec x read p99 x max staleness, plus the exactness verdict
+    # of the served view vs a recompute (runtime/ingest.py)
+    ing_left = deadline - EMIT_MARGIN - time.monotonic()
+    if os.environ.get("BENCH_INGEST") == "1" and ing_left > 60:
+        env = dict(env_base, BENCH_INGEST_CHILD="1",
+                   BENCH_STAGE_QUERIES="1",
+                   BENCH_CHILD_DEADLINE=str(time.time() + ing_left - 10))
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        state["child"] = proc
+        try:
+            proc.communicate(timeout=ing_left)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()  # reap
+            state["stage_meta"].append({"attempt": "ingest",
                                         "error": "timeout"})
         finally:
             state["child"] = None

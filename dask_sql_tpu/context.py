@@ -40,6 +40,14 @@ def _tenancy_on() -> bool:
     return os.environ.get("DSQL_TENANCY", "1").strip() not in ("", "0")
 
 
+def _ingest_on() -> bool:
+    # continuous-ingestion gate (runtime/ingest.py): DSQL_INGEST_DIR arms,
+    # DSQL_INGEST=0 kills — both checked BEFORE any import so the unarmed
+    # write/read paths stay bit-for-bit baseline with the module absent
+    return bool(os.environ.get("DSQL_INGEST_DIR")) and \
+        os.environ.get("DSQL_INGEST", "1").strip() not in ("0", "false")
+
+
 class Context:
     """Main entry point: holds schemas/tables/functions/models and runs SQL.
 
@@ -88,6 +96,17 @@ class Context:
                 _fleet.ensure_armed()
             except Exception:
                 logger.debug("fleet arming failed", exc_info=True)
+        # continuous ingestion (runtime/ingest.py): same env-before-import
+        # discipline — an unset DSQL_INGEST_DIR (or DSQL_INGEST=0) leaves
+        # the module un-imported and the write path bit-for-bit baseline.
+        # Arming opens the per-table WAL and replays committed batches for
+        # tables registered later (create_table calls maybe_replay).
+        if _ingest_on():
+            try:
+                from .runtime import ingest as _ing
+                _ing.ensure_armed(self)
+            except Exception:
+                logger.debug("ingest arming failed", exc_info=True)
         # register default input plugins (reference context.py:113-119 order)
         for plugin in (DeviceTableInputPlugin(), PandasLikeInputPlugin(),
                        DictInputPlugin(), ArrowInputPlugin(), HiveInputPlugin(),
@@ -99,8 +118,31 @@ class Context:
     # ------------------------------------------------------------- epochs
     def table_epoch(self, schema_name: str, table_name: str) -> int:
         """Current catalog epoch of (schema, table); 0 = never mutated
-        since this Context was created."""
+        since this Context was created.  Under an armed ingest subsystem
+        a query running inside a snapshot pin (runtime/ingest.py) reads
+        the epoch AS OF admission, so result-cache keys stay consistent
+        with the pinned table contents."""
+        if _ingest_on():
+            from .runtime import ingest as _ing
+            pinned = _ing.pinned_epoch(schema_name, table_name.lower())
+            if pinned is not None:
+                return pinned
         return self._table_epochs.get((schema_name, table_name.lower()), 0)
+
+    def catalog_entry(self, schema_name: str, table_name: str):
+        """The executor-facing catalog read (physical/rel/executor.py,
+        physical/compiled.py): identical to
+        ``self.schema[schema_name].tables[table_name]`` except that inside
+        a snapshot pin it returns the entry captured at admission — a
+        query sees one consistent prefix of every table it scans even
+        while the ingest writer keeps appending.  Raises KeyError exactly
+        like the direct lookup."""
+        if _ingest_on():
+            from .runtime import ingest as _ing
+            entry = _ing.pinned_entry(schema_name, table_name)
+            if entry is not None:
+                return entry
+        return self.schema[schema_name].tables[table_name]
 
     def bump_table_epoch(self, schema_name: str, table_name: str,
                          delta: Optional[Table] = None) -> int:
@@ -214,6 +256,16 @@ class Context:
                            gpu=gpu, row_valid=row_valid, stats=stats)
         self.schema[schema_name].tables[table_name.lower()] = entry
         self.bump_table_epoch(schema_name, table_name)
+        if _ingest_on():
+            # restart path: committed WAL batches recorded against this
+            # table in a previous process apply as soon as the base is
+            # re-registered (crash recovery loses zero committed batches)
+            try:
+                from .runtime import ingest as _ing
+                log = _ing.get_log(self, create=True)
+                log.maybe_replay(schema_name, table_name.lower())
+            except Exception:
+                logger.debug("ingest replay failed", exc_info=True)
         logger.debug("Registered table %s.%s (%d rows)", schema_name,
                      table_name, table.num_rows)
 
@@ -262,12 +314,19 @@ class Context:
         ``rows``: a device ``Table``, pandas DataFrame, dict of columns, or
         list of row tuples (matched positionally).  Columns align to the
         target case-insensitively (or positionally when the names do not
-        match), values cast to the target column types.  Returns the number
-        of rows appended.  ``INSERT INTO`` lowers to this.
+        match; a named strict subset NULL-fills the rest), values cast to
+        the target column types — anything that does not fit raises a
+        typed ``SchemaMismatch``.  Returns the number of rows appended.
+        ``INSERT INTO`` lowers to this.
+
+        With the ingest subsystem armed (DSQL_INGEST_DIR, ISSUE 20) the
+        batch goes through the write-ahead log first — durable before
+        visible, possibly coalesced with neighbors (DSQL_INGEST_BATCH_*),
+        priced through the memory broker (IngestBackpressure when the
+        budget cannot absorb it).  The return value is then the rows made
+        visible NOW (0 = accepted into the micro-batch buffer).
         """
-        from .ops.join import concat_tables
         from .runtime.resilience import UserError
-        from .runtime.statistics import collect_table_stats
 
         schema_name = schema_name or self.schema_name
         entry = self.schema[schema_name].tables.get(table_name.lower())
@@ -292,6 +351,28 @@ class Context:
         delta = _coerce_delta(entry.table, rows)
         if delta.num_rows == 0:
             return 0
+        if _ingest_on():
+            from .runtime import ingest as _ing
+            log = _ing.get_log(self, create=True)
+            return log.commit(schema_name, table_name.lower(), delta)
+        return self._apply_delta(schema_name, table_name.lower(), delta)
+
+    def _apply_delta(self, schema_name: str, table_name: str,
+                     delta: Table) -> int:
+        """Make one coerced batch visible: new catalog entry + delta-carrying
+        epoch bump.  The tail of the pre-ingest ``append_rows``; the ingest
+        log calls it after the WAL write (and on replay).  Re-fetches the
+        entry and re-coerces — under micro-batching the table may have been
+        swapped (or its schema altered) since the batch was coerced."""
+        from .ops.join import concat_tables
+        from .runtime.resilience import UserError
+        from .runtime.statistics import collect_table_stats
+
+        entry = self.schema[schema_name].tables.get(table_name)
+        if entry is None or entry.table is None:
+            raise UserError(f"Table {table_name} not found in schema "
+                            f"{schema_name}; create it before INSERT INTO.")
+        delta = _coerce_delta(entry.table, delta)
         if self.mesh is not None:
             # sharded base: concat on host against the valid prefix, then
             # re-shard — appends are rare relative to scans, so the round
@@ -313,11 +394,22 @@ class Context:
             new_table = concat_tables([entry.table, delta])
             row_valid = None
         stats = collect_table_stats(new_table, row_valid=row_valid)
-        self.schema[schema_name].tables[table_name.lower()] = TableEntry(
+        new_entry = TableEntry(
             table=new_table, statistics=entry.statistics,
             filepath=entry.filepath, gpu=entry.gpu, row_valid=row_valid,
             stats=stats)
-        self.bump_table_epoch(schema_name, table_name, delta=delta)
+        reg = self.__dict__.get("_matview_registry")
+        if reg is not None:
+            # the catalog swap and the delta record must be one atomic
+            # step under the registry lock: a refresh that reads the new
+            # table before its delta is logged would double-count the
+            # appended rows (delta-join slices old prefixes by row count)
+            with reg.lock:
+                self.schema[schema_name].tables[table_name] = new_entry
+                self.bump_table_epoch(schema_name, table_name, delta=delta)
+        else:
+            self.schema[schema_name].tables[table_name] = new_entry
+            self.bump_table_epoch(schema_name, table_name, delta=delta)
         logger.debug("Appended %d rows to %s.%s (now %d)", delta.num_rows,
                      schema_name, table_name, new_table.num_rows)
         return delta.num_rows
@@ -527,7 +619,15 @@ class Context:
         if _tenancy_on():
             from .runtime import tenancy as _ten
             ten_adm = _ten.admission()
-        with ten_adm, _sched.get_manager().admission(plan, self):
+        # snapshot isolation under the ingest writer (runtime/ingest.py):
+        # pin every scanned table's (entry, epoch) at admission — the
+        # query then reads one consistent prefix of the delta log however
+        # long it runs and wherever its scans execute
+        pin = nullcontext()
+        if _ingest_on():
+            from .runtime import ingest as _ing
+            pin = _ing.pin_scope(self, plan)
+        with ten_adm, _sched.get_manager().admission(plan, self), pin:
             return self._run_query_plan(plan)
 
     def _run_query_plan(self, plan):
@@ -820,11 +920,14 @@ class Context:
 
 def _coerce_delta(target: Table, rows: Any) -> Table:
     """Shape ``rows`` into a Table matching ``target``'s column names and
-    types (append_rows' alignment/cast step)."""
+    types (append_rows' alignment/cast step).  Anything that does not fit
+    the target schema raises a typed ``SchemaMismatch`` (a ``UserError``:
+    the server wire maps it to HTTP 400) naming the offending columns —
+    never a raw coercion traceback."""
     import pandas as pd
 
     from .physical.rex.cast import cast_column
-    from .runtime.resilience import UserError
+    from .runtime.resilience import SchemaMismatch, UserError
 
     if isinstance(rows, Table):
         df = rows.to_pandas()
@@ -833,28 +936,58 @@ def _coerce_delta(target: Table, rows: Any) -> Table:
     elif isinstance(rows, dict):
         df = pd.DataFrame(rows)
     elif isinstance(rows, (list, tuple)):
+        width = {len(r) for r in rows if isinstance(r, (list, tuple))}
+        if width - {len(target.names)}:
+            raise SchemaMismatch(
+                f"appended row tuples have {sorted(width)} values but the "
+                f"table has {len(target.names)} columns "
+                f"({list(target.names)})")
         df = pd.DataFrame(list(rows), columns=list(target.names))
     else:
         raise UserError(
             "append_rows accepts a Table, pandas DataFrame, dict of "
             f"columns, or list of row tuples; got {type(rows).__name__}")
     lower_map = {str(c).lower(): c for c in df.columns}
+    target_lower = {n.lower() for n in target.names}
     if all(n.lower() in lower_map for n in target.names) and \
             len(df.columns) == len(target.names):
         df = df[[lower_map[n.lower()] for n in target.names]]
+        df = df.set_axis(list(target.names), axis=1)
     elif len(df.columns) == len(target.names):
-        pass  # positional: trust the order
+        df = df.set_axis(list(target.names), axis=1)  # positional order
+    elif 0 < len(df.columns) < len(target.names) and \
+            set(lower_map) <= target_lower:
+        # named strict subset: the batch supplies some target columns by
+        # name — NULL-fill the rest (INSERT INTO t (a, c) semantics)
+        df = pd.DataFrame({
+            n: (df[lower_map[n.lower()]].reset_index(drop=True)
+                if n.lower() in lower_map
+                else pd.Series([None] * len(df), dtype=object))
+            for n in target.names})
     else:
-        raise UserError(
+        extra = sorted(set(lower_map) - target_lower)
+        missing = sorted(target_lower - set(lower_map))
+        detail = []
+        if extra:
+            detail.append(f"unknown column(s) {extra}")
+        if missing:
+            detail.append(f"missing column(s) {missing}")
+        raise SchemaMismatch(
             f"appended rows have columns {list(df.columns)} but the table "
-            f"has {list(target.names)}; supply every target column (by "
-            "name, any case, or positionally)")
-    df = df.set_axis(list(target.names), axis=1)
+            f"has {list(target.names)}: " + "; ".join(detail) +
+            " — supply target columns by name (any case, a subset "
+            "NULL-fills the rest) or all of them positionally")
     delta = Table.from_pandas(df)
     cols = []
-    for col, tgt in zip(delta.columns, target.columns):
+    for col, tgt, name in zip(delta.columns, target.columns, target.names):
         if col.stype.name != tgt.stype.name:
-            col = cast_column(col, tgt.stype)
+            try:
+                col = cast_column(col, tgt.stype)
+            except Exception as exc:
+                raise SchemaMismatch(
+                    f"column {name!r} of the appended rows "
+                    f"({col.stype.name}) does not cast to the table's "
+                    f"{tgt.stype.name}: {exc}") from exc
         cols.append(col)
     return Table(list(target.names), cols)
 

@@ -208,7 +208,9 @@ def _fp_plan(rel: RelNode, context, scans: list, params=None) -> str:
     t = type(rel).__name__
     schema = ";".join(f"{f.name}:{f.stype.name}" for f in rel.schema)
     if isinstance(rel, LogicalTableScan):
-        entry = context.schema[rel.schema_name].tables[rel.table_name]
+        # snapshot-pin-aware read (runtime/ingest.py): the compiled program
+        # binds the tables captured at admission, not a mid-append swap
+        entry = context.catalog_entry(rel.schema_name, rel.table_name)
         if entry.table is None:
             raise Unsupported("view scan")
         if entry.table.num_rows == 0:

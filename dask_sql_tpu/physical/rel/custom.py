@@ -135,7 +135,7 @@ def _insert_into(stmt: A.InsertInto, context, sql):
     """INSERT INTO: run the source query (VALUES lowers to a query too)
     through the normal execution path, then hand the rows to
     ``Context.append_rows`` — the delta-recording append seam."""
-    from ...runtime.resilience import UserError
+    from ...runtime.resilience import SchemaMismatch
 
     plan = context._get_plan(stmt.query, sql)
     rows = context._execute_query_plan(plan)
@@ -143,7 +143,7 @@ def _insert_into(stmt: A.InsertInto, context, sql):
     payload = rows
     if stmt.columns is not None:
         if len(stmt.columns) != rows.num_columns:
-            raise UserError(
+            raise SchemaMismatch(
                 f"INSERT INTO {name} names {len(stmt.columns)} columns but "
                 f"the source produces {rows.num_columns}.")
         entry = context.schema[schema_name].tables.get(name)
@@ -155,7 +155,7 @@ def _insert_into(stmt: A.InsertInto, context, sql):
             unknown = [c for c in df.columns
                        if c not in {t.lower() for t in target}]
             if unknown:
-                raise UserError(
+                raise SchemaMismatch(
                     f"INSERT INTO {name} names columns {unknown} that the "
                     f"table does not have (columns: {target}).")
             # unnamed target columns fill NULL

@@ -68,7 +68,9 @@ class RelExecutor(Pluggable):
 # ---------------------------------------------------------------------------
 
 def _table_scan(rel: LogicalTableScan, ex: RelExecutor) -> Table:
-    entry = ex.context.schema[rel.schema_name].tables[rel.table_name]
+    # catalog_entry (not a direct dict read): inside a snapshot pin
+    # (runtime/ingest.py) this serves the entry captured at admission
+    entry = ex.context.catalog_entry(rel.schema_name, rel.table_name)
     if entry.table is not None:
         t = entry.table
         if entry.row_valid is not None:

@@ -76,7 +76,8 @@ from .resilience import FatalError, TransientError, interruptible_sleep
 
 SITES = ("compile", "materialize", "stage_exec", "stage_replay",
          "chunked_read", "host_transfer", "cache_populate", "admission",
-         "drain", "spill", "mv_refresh", "result_spool", "autopilot")
+         "drain", "spill", "mv_refresh", "result_spool", "autopilot",
+         "ingest")
 
 
 class FaultInjected(TransientError):

@@ -47,6 +47,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -100,12 +101,13 @@ class DeltaRecord:
     epoch: int          # the epoch this append advanced the table TO
     rows: int
     table: Table        # the appended batch, base-table column order
+    ts: float = 0.0     # wall-clock record time (staleness accounting)
 
 
 @dataclass
 class _Shape:
     """Maintenance shape of a maintainable plan (see module docstring)."""
-    kind: str                          # "agg" | "append"
+    kind: str                          # "agg" | "append" | "join" | "cdistinct"
     scan: LogicalTableScan = None
     below: RelNode = None              # agg: pipeline under the aggregate
     agg: Optional[LogicalAggregate] = None
@@ -116,6 +118,8 @@ class _Shape:
     merge_schema: list = field(default_factory=list)
     post_exprs: list = field(default_factory=list)
     needs_project: bool = False
+    scans: list = field(default_factory=list)  # join: left-to-right leaves
+    cd_arg: int = -1                   # cdistinct: DISTINCT arg in `below`
 
 
 @dataclass
@@ -153,6 +157,8 @@ def _analyze(plan: RelNode, context) -> Tuple[Optional[_Shape], str]:
     from ..physical.streaming import StreamingUnsupported, \
         _partial_and_merge_aggs
 
+    from ..plan.nodes import LogicalJoin
+
     chain: List[RelNode] = []
     cur = plan
     while not isinstance(cur, LogicalTableScan):
@@ -161,9 +167,13 @@ def _analyze(plan: RelNode, context) -> Tuple[Optional[_Shape], str]:
             chain.append(cur)
             cur = cur.inputs[0]
             continue
+        if isinstance(cur, LogicalJoin):
+            from . import delta as _delta
+            return _delta.analyze_join(plan, chain, cur, context)
         return None, (f"{cur.node_name()} requires full recompute (only "
-                      "selection/projection pipelines and single-level "
-                      "mergeable group-bys maintain incrementally)")
+                      "selection/projection pipelines, INNER join trees, "
+                      "and single-level mergeable group-bys maintain "
+                      "incrementally)")
     scan = cur
     schema = context.schema.get(scan.schema_name)
     entry = schema.tables.get(scan.table_name) if schema is not None else None
@@ -196,6 +206,12 @@ def _analyze(plan: RelNode, context) -> Tuple[Optional[_Shape], str]:
            for n in below_chain):
         return None, "ORDER BY/LIMIT below the aggregate requires full " \
                      "recompute"
+    if any(c.distinct for c in agg.aggs):
+        # the streaming algebra refuses DISTINCT outright; the refcounted
+        # state in runtime/delta.py maintains the COUNT(DISTINCT col) form
+        from . import delta as _delta
+        return _delta.analyze_distinct_agg(plan, scan, agg, above,
+                                           below_chain)
     try:
         (partial_aggs, partial_fields, merge_aggs, post_exprs,
          needs_project) = _partial_and_merge_aggs(agg)
@@ -304,14 +320,21 @@ class MatViewRegistry:
                 return  # no dependent views: nothing to maintain
             log = self.deltas.setdefault(key, [])
             if len(log) >= MAX_DELTAS:
+                # before giving up on incremental maintenance, coalesce
+                # the unconsumed tail into one record: a steady trickle
+                # of tiny appends then stays O(delta) instead of
+                # tombstoning into a full recompute
+                self._compact_locked(key, log)
+            if len(log) >= MAX_DELTAS:
                 logger.info("matview: delta log for %s.%s overflowed "
                             "(%d records); tombstoning", key[0], key[1],
                             len(log))
                 self._tombstone_locked(key, epoch)
                 return
             log.append(DeltaRecord(epoch=epoch, rows=table.num_rows,
-                                   table=table))
+                                   table=table, ts=time.time()))
             _tel.inc("mv_deltas_recorded")
+            self._update_gauges_locked()
 
     def record_overwrite(self, key: Tuple[str, str], epoch: int) -> None:
         with self.lock:
@@ -320,6 +343,39 @@ class MatViewRegistry:
     def _tombstone_locked(self, key, epoch: int) -> None:
         self.deltas.pop(key, None)
         self.tombstones[key] = epoch
+        self._update_gauges_locked()
+
+    def _compact_locked(self, key, log: List[DeltaRecord]) -> None:
+        """Merge adjacent unconsumed records into one batch.  Only records
+        strictly ABOVE every dependent view's watermark may merge — a
+        record a view has partially consumed must keep its epoch so
+        _staleness's hole detection stays exact."""
+        from ..ops.join import concat_tables
+
+        hi = max((v.base_epochs.get(key, 0) for v in self.views.values()
+                  if key in v.base_epochs), default=0)
+        tail = [r for r in log if r.epoch > hi]
+        if len(tail) < 2:
+            return
+        merged = DeltaRecord(
+            epoch=max(r.epoch for r in tail),
+            rows=sum(r.rows for r in tail),
+            table=concat_tables([r.table for r in tail]),
+            ts=min(r.ts for r in tail))
+        log[:] = [r for r in log if r.epoch <= hi] + [merged]
+        _tel.inc("mv_delta_compactions")
+        logger.info("matview: compacted %d delta record(s) for %s.%s into "
+                    "one %d-row batch", len(tail), key[0], key[1],
+                    merged.rows)
+
+    def _update_gauges_locked(self) -> None:
+        pending = sum(r.rows for recs in self.deltas.values() for r in recs)
+        oldest = min((r.ts for recs in self.deltas.values()
+                      for r in recs if r.ts), default=0.0)
+        _tel.REGISTRY.set_gauge("mv_pending_rows", pending)
+        _tel.REGISTRY.set_gauge(
+            "mv_staleness_s", max(time.time() - oldest, 0.0)
+            if oldest else 0.0)
 
     def discard_view(self, schema_name: str, name: str) -> None:
         """Registry-side cleanup when the catalog entry goes away through
@@ -438,7 +494,14 @@ class MatViewRegistry:
         from . import result_cache as _rc
 
         shape = mv.shape
-        (key,) = pending.keys()  # maintainable shapes have one base scan
+        if shape.kind == "join":
+            from . import delta as _delta
+            try:
+                _delta.refresh_join(self, context, mv, pending)
+            finally:
+                _cleanup_temps(context)
+            return
+        (key,) = pending.keys()  # single-scan shapes have one base scan
         delta = concat_tables([r.table for r in pending[key]])
         # the scan may be column-pruned/reordered relative to the base
         # table layout the delta was recorded in — align by name
@@ -452,6 +515,10 @@ class MatViewRegistry:
                 f"delta does not cover scanned column {exc}") from exc
         try:
             delta_scan = _register_temp(context, delta, shape.scan.schema)
+            if shape.kind == "cdistinct":
+                from . import delta as _delta
+                _delta.refresh_cdistinct(self, context, mv, delta_scan)
+                return
             if shape.kind == "append":
                 new_rows = _execute_plan(
                     context, _replace(mv.plan, shape.scan, delta_scan),
@@ -531,6 +598,10 @@ class MatViewRegistry:
                 cache = _rc.get_cache()
                 if cache.enabled():
                     cache.put(_state_key(mv), state)
+            elif mv.maintainable and mv.shape.kind == "cdistinct":
+                # same seeding discipline, refcounted state
+                from . import delta as _delta
+                _delta.refresh_full_cdistinct(self, context, mv)
             else:
                 result = _execute_plan(context, mv.plan)
                 self._swap(context, mv, result)
@@ -565,6 +636,7 @@ class MatViewRegistry:
             self.deltas[key] = [r for r in self.deltas[key] if r.epoch > lo]
             if not self.deltas[key]:
                 del self.deltas[key]
+        self._update_gauges_locked()
 
 
 def get_registry(context, create: bool = False) -> Optional[MatViewRegistry]:
@@ -659,6 +731,10 @@ def matview_rows(context) -> List[dict]:
         for (schema_name, name), mv in sorted(reg.views.items()):
             entry = context.schema.get(schema_name)
             entry = entry.tables.get(name) if entry is not None else None
+            pending = [r for k in mv.base_tables
+                       for r in reg.deltas.get(k, ())
+                       if r.epoch > mv.base_epochs.get(k, 0)]
+            ts = [r.ts for r in pending if r.ts]
             out.append({
                 "schema": schema_name,
                 "name": name,
@@ -670,10 +746,10 @@ def matview_rows(context) -> List[dict]:
                 "reason": mv.reason,
                 "base_tables": ",".join(f"{s}.{t}"
                                         for s, t in mv.base_tables),
-                "pending_deltas": sum(
-                    len([r for r in reg.deltas.get(k, ())
-                         if r.epoch > mv.base_epochs.get(k, 0)])
-                    for k in mv.base_tables),
+                "pending_deltas": len(pending),
+                "pending_rows": sum(r.rows for r in pending),
+                "staleness_s": (round(max(time.time() - min(ts), 0.0), 3)
+                                if ts else 0.0),
                 "serves": mv.serves,
                 "refresh_incremental": mv.refresh_incremental,
                 "refresh_full": mv.refresh_full,

@@ -126,6 +126,16 @@ class QueryCancelled(UserError):
     error_name = "USER_CANCELED"
 
 
+class SchemaMismatch(UserError):
+    """An append batch (``append_rows`` / ``INSERT INTO ... SELECT`` /
+    ``POST /v1/ingest``) does not fit the target table's schema: missing
+    or extra columns, wrong arity, or a value that cannot cast to the
+    target column type.  A user mistake by construction — the server
+    surfaces it as HTTP 400 rather than a raw coercion traceback."""
+
+    error_name = "SCHEMA_MISMATCH"
+
+
 class AdmissionRejected(ResilienceError):
     """The workload manager (runtime/scheduler.py) refused the query at
     submit time: queue full, or the deadline would expire before a slot
@@ -185,6 +195,17 @@ class LoadShedRejected(AdmissionRejected):
     when the burn recovers."""
 
     error_name = "SLO_LOAD_SHED"
+
+
+class IngestBackpressure(AdmissionRejected):
+    """The continuous-ingestion write path (runtime/ingest.py) priced an
+    append batch through the scheduler's memory broker and the device
+    budget cannot absorb it right now: the writer must back off.  Rides
+    the AdmissionRejected wire path (HTTP 429 + ``Retry-After``) so a
+    well-behaved writer client retries instead of growing the working
+    set past what readers were admitted against."""
+
+    error_name = "INGEST_BACKPRESSURE"
 
 
 # exception type NAMES (not imports: the parser/binder layer must stay

@@ -377,6 +377,8 @@ def _matviews(context=None) -> Table:
         "reason": _col(rows, "reason", object, ""),
         "base_tables": _col(rows, "base_tables", object, ""),
         "pending_deltas": _col(rows, "pending_deltas", np.int64, 0),
+        "pending_rows": _col(rows, "pending_rows", np.int64, 0),
+        "staleness_s": _col(rows, "staleness_s", np.float64, 0.0),
         "serves": _col(rows, "serves", np.int64, 0),
         "refresh_incremental": _col(rows, "refresh_incremental",
                                     np.int64, 0),

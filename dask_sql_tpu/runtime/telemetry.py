@@ -198,6 +198,18 @@ STABLE_COUNTERS: Tuple[str, ...] = (
     "autopilot_mv_refreshes", "autopilot_mv_serves",
     "autopilot_hints_recorded", "autopilot_hints_applied",
     "autopilot_hints_reverted",
+    # continuous ingestion (runtime/ingest.py, ISSUE 20): WAL-committed
+    # batches/rows, micro-batch buffer traffic (buffered appends + flushes
+    # that drained them), restart replay, memory-broker backpressure
+    # rejects, torn WAL lines skipped on replay, /v1/ingest requests, the
+    # fault_ingest injection site, and delta-log compactions that kept a
+    # trickle of tiny appends on the incremental path (runtime/matview.py)
+    "ingest_batches_committed", "ingest_rows_committed",
+    "ingest_batches_buffered", "ingest_flushes",
+    "ingest_replayed_batches", "ingest_replayed_rows",
+    "ingest_backpressure_rejects", "ingest_wal_torn_lines",
+    "server_ingest_requests", "fault_ingest",
+    "mv_delta_compactions",
 )
 
 STABLE_HISTOGRAMS: Tuple[str, ...] = (
@@ -241,6 +253,12 @@ STABLE_GAUGES: Tuple[str, ...] = (
     # the last fleet snapshot, and the fleet-wide sum of every alive
     # replica's program_store_hits — the shared-warmth proof counter
     "fleet_replicas_alive", "fleet_warm_serves",
+    # continuous ingestion (runtime/ingest.py): WAL bytes on disk, rows
+    # sitting in un-flushed micro-batch buffers, and view staleness —
+    # un-applied delta rows across all registered matview base tables +
+    # age in seconds of the oldest pending delta (0 when fully fresh)
+    "ingest_wal_bytes", "ingest_buffered_rows",
+    "mv_pending_rows", "mv_staleness_s",
 )
 
 # exponential-ish bucket bounds in milliseconds; histograms are BOUNDED by

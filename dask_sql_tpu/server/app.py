@@ -80,6 +80,13 @@ ERROR_WIRE_MATRIX = {
     "TenantCircuitOpen": (429, "INSUFFICIENT_RESOURCES",
                           "TENANT_CIRCUIT_OPEN"),
     "LoadShedRejected": (429, "INSUFFICIENT_RESOURCES", "SLO_LOAD_SHED"),
+    # continuous ingestion (runtime/ingest.py): a write whose batch the
+    # memory broker cannot absorb rides the 429 + Retry-After path; a
+    # batch that does not fit the target table schema is the writer's
+    # mistake — 400, never a retry
+    "IngestBackpressure": (429, "INSUFFICIENT_RESOURCES",
+                           "INGEST_BACKPRESSURE"),
+    "SchemaMismatch": (400, "USER_ERROR", "SCHEMA_MISMATCH"),
     "ServerDraining": (503, "INSUFFICIENT_RESOURCES",
                        "SERVER_SHUTTING_DOWN"),
     "SpillError": (200, "INTERNAL_ERROR", "SPILL_ERROR"),
@@ -106,6 +113,15 @@ def _fleet_on() -> bool:
     an unset DSQL_FLEET_DIR keeps the module un-imported, /v1/fleet on
     the generic 404, and every wire byte byte-identical."""
     return bool(os.environ.get("DSQL_FLEET_DIR"))
+
+
+def _ingest_on() -> bool:
+    """Continuous-ingestion gate (runtime/ingest.py): DSQL_INGEST_DIR
+    arms, DSQL_INGEST=0 kills — both checked BEFORE any import so the
+    unarmed wire (no /v1/ingest route, no engine section) stays
+    byte-identical with the module absent."""
+    return bool(os.environ.get("DSQL_INGEST_DIR")) and \
+        os.environ.get("DSQL_INGEST", "1").strip() not in ("0", "false")
 
 
 def _page_rows() -> int:
@@ -139,6 +155,8 @@ def submit_status(exc: Exception) -> int:
         return 503
     if isinstance(exc, _res.AdmissionRejected):
         return 429
+    if isinstance(exc, _res.SchemaMismatch):
+        return 400
     return 200
 
 
@@ -576,6 +594,12 @@ def _engine_snapshot(state: "_AppState") -> dict:
             out["autopilot"] = _ap.engine_section()
         except Exception:
             logger.debug("autopilot engine section failed", exc_info=True)
+    if _ingest_on():
+        try:
+            from ..runtime import ingest as _ing
+            out["ingest"] = _ing.engine_section(state.context)
+        except Exception:
+            logger.debug("ingest engine section failed", exc_info=True)
     return out
 
 
@@ -1160,8 +1184,11 @@ def _make_handler(state: _AppState, base_url: str):
             self.end_headers()
             self.wfile.write(body)
 
-        # POST /v1/statement
+        # POST /v1/statement | POST /v1/ingest (armed subsystems only)
         def do_POST(self):
+            if self.path.rstrip("/") == "/v1/ingest" and _ingest_on():
+                self._do_ingest()
+                return
             if self.path.rstrip("/") != "/v1/statement":
                 self._send(404, {"error": "not found"})
                 return
@@ -1274,6 +1301,100 @@ def _make_handler(state: _AppState, base_url: str):
                 "stats": _stats("QUEUED", info),
             }, headers=self._trace_headers(tid=tid))
 
+        def _do_ingest(self):
+            """POST /v1/ingest (runtime/ingest.py; route 404s unarmed):
+            one WAL-committed append per request.  Body::
+
+                {"table": "t", "rows": [[...], ...] | {"col": [...]},
+                 "schema": "root"?}
+
+            Tenant-tagged (X-DSQL-Tenant) and quota-governed exactly like
+            a statement; the writer's typed verdicts ride the audited
+            wire — 429 + Retry-After on quota/backpressure, 400 on a
+            schema mismatch, 503 draining."""
+            _tel.inc("server_ingest_requests")
+            uid = str(uuid_mod.uuid4())
+            tid = None
+            if _events_on():
+                from ..runtime import events as _ev
+                tid = self._req_trace() or _ev.mint_trace_id()
+
+            def reject(e: _res.AdmissionRejected) -> None:
+                hdrs = {"Retry-After":
+                        str(max(int(math.ceil(e.retry_after_s)), 1))}
+                hdrs.update(self._trace_headers(tid=tid) or {})
+                if tid:
+                    from ..runtime import events as _ev
+                    _ev.publish("server.rejected", trace=tid,
+                                error=type(e).__name__,
+                                retry_after_s=round(e.retry_after_s, 3))
+                self._send(submit_status(e),
+                           _error_payload(str(e), uid, exc=e), headers=hdrs)
+
+            mgr = _sched.get_manager()
+            if mgr.draining():
+                _tel.inc("server_drain_rejects")
+                reject(mgr._drain_verdict())
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                payload = json.loads(self.rfile.read(length).decode())
+                table = payload["table"]
+                rows = payload["rows"]
+                schema_name = payload.get("schema") or None
+                if not isinstance(rows, (list, dict)):
+                    raise TypeError("rows must be a list or dict")
+            except Exception:
+                self._send(400, _error_payload(
+                    'Invalid ingest body (expected {"table": "...", '
+                    '"rows": [[...], ...] | {"col": [...]}, '
+                    '"schema": "..."?})', uid),
+                    headers=self._trace_headers(tid=tid))
+                return
+            grant = None
+            if _tenancy_on():
+                from ..runtime import tenancy as _ten
+                try:
+                    grant = _ten.get_registry().claim(
+                        self.headers.get("X-DSQL-Tenant"))
+                except _res.AdmissionRejected as e:
+                    _tel.inc("server_throttled")
+                    reject(e)
+                    return
+            outcome = None  # rejects feed neither breaker nor counts
+            try:
+                if isinstance(rows, list):
+                    rows = [tuple(r) if isinstance(r, list) else r
+                            for r in rows]
+                n = state.context.append_rows(table, rows,
+                                              schema_name=schema_name)
+                outcome = "ok"
+                self._send(200, {
+                    "id": uid,
+                    "table": table,
+                    "state": "COMMITTED" if n else "BUFFERED",
+                    "rows": int(n),
+                    "epoch": state.context.table_epoch(
+                        schema_name or state.context.schema_name,
+                        str(table)),
+                }, headers=self._trace_headers(tid=tid))
+            except _res.AdmissionRejected as e:
+                # backpressure/quota mid-commit: honest Retry-After
+                _tel.inc("server_throttled")
+                reject(e)
+            except Exception as e:
+                outcome = "error"
+                err = _res.classify(e, default=_res.UserError)
+                if err is None:  # control-flow: re-raise untouched
+                    raise
+                self._send(submit_status(err),
+                           _error_payload(str(err), uid, exc=err),
+                           headers=self._trace_headers(tid=tid))
+            finally:
+                if grant is not None:
+                    from ..runtime import tenancy as _ten
+                    _ten.get_registry().release(grant, outcome=outcome)
+
         # DELETE /v1/cancel/{uuid}
         def do_DELETE(self):
             if self.path.startswith("/v1/cancel/"):
@@ -1375,6 +1496,13 @@ def run_server(context=None, host: str = "0.0.0.0", port: int = 8080,
         from ..runtime import fleet as _fleet
         _fleet.ensure_armed()
     context = context or Context()
+    # continuous ingestion: arm on the serving context before the first
+    # request — opens the WAL, replays committed batches for registered
+    # tables, starts the micro-batch flusher (idempotent with the
+    # Context.__init__ hook; env checked before the import)
+    if _ingest_on():
+        from ..runtime import ingest as _ing
+        _ing.ensure_armed(context)
     if startup:
         context.sql("SELECT 1 + 1")
 

@@ -84,6 +84,11 @@ os.environ.setdefault("DSQL_STAGE_HEAVY", "1")
 # time and ABANDONS half of them mid-chain for the reaper to GC
 os.environ.setdefault("DSQL_RESULT_PAGE_ROWS", "200")
 os.environ.setdefault("DSQL_RESULT_TTL_S", "3")
+# WAL-armed ingest (ISSUE 20): every append in the soak routes through
+# the write-ahead log + the ``ingest`` fault site, and two dedicated
+# clients keep join/DISTINCT views oracle-exact against acked batches
+os.environ.setdefault("DSQL_INGEST_DIR",
+                      tempfile.mkdtemp(prefix="dsql_chaos_ingest_"))
 # arm the per-tenant circuit breaker so the rare FATAL compile faults
 # exercise trip -> open -> half-open probe -> close IN-SOAK
 os.environ.setdefault("DSQL_TENANT_BREAKER", "3")
@@ -218,6 +223,25 @@ def main(argv=None) -> int:
     ta = t1[["k", "v"]].copy()
     ctx.create_table("ta", ta)
 
+    # the ingest clients' private bases + maintained join/DISTINCT views
+    # (ISSUE 20): one writer appends over the wire (POST /v1/ingest), one
+    # in-process; a faulted append is rejected BEFORE the WAL commit point
+    # (never half-committed), so each oracle advances only on acked batches
+    rngj = np.random.RandomState(args.seed + 5)
+    tij = pd.DataFrame({"k": rngj.randint(0, 20, 500),
+                        "v": np.round(rngj.rand(500) * 10, 3)})
+    tdj = pd.DataFrame({"k": np.arange(20),
+                        "c": np.round(np.arange(20) * 0.5, 3)})
+    ctx.create_table("tij", tij)
+    ctx.create_table("tdj", tdj)
+    ctx.sql("CREATE MATERIALIZED VIEW vji AS "
+            "SELECT tij.k AS k, tij.v AS v, tdj.c AS c "
+            "FROM tij JOIN tdj ON tij.k = tdj.k")
+    tdc = pd.DataFrame({"k": rngj.randint(0, 50, 400)})
+    ctx.create_table("tdc", tdc)
+    ctx.sql("CREATE MATERIALIZED VIEW vdc AS "
+            "SELECT COUNT(DISTINCT k) AS n FROM tdc")
+
     # probabilistic faults on EVERY site, deterministic per-site streams,
     # plus a rare FATAL compile fault (exile + quarantine coverage)
     spec = ",".join(f"{s}:p={args.p}:seed={args.seed + i}"
@@ -235,6 +259,8 @@ def main(argv=None) -> int:
     stats = {"submitted": 0, "ok": 0, "typed": 0, "untyped": 0, "wrong": 0}
     http = {"submitted": 0, "ok": 0, "typed": 0, "abandoned": 0,
             "untyped": 0, "wrong": 0}
+    ing = {"appends": 0, "committed": 0, "rejected": 0, "untyped": 0}
+    ing_state = {}  # final per-client oracles for the post-soak audit
     problems = []
 
     t_end = time.monotonic() + args.budget_s
@@ -276,9 +302,10 @@ def main(argv=None) -> int:
     def mv_client() -> None:
         # single mutator of tm: the pandas oracle below is authoritative.
         # Appends go through Context.append_rows directly (deterministic —
-        # the mutation either lands with its delta record or raises before
-        # touching the catalog), reads go through the full ctx.sql path
-        # where admission faults, refresh faults, and the scheduler apply.
+        # under the armed WAL the mutation either commits with its delta
+        # record or raises a typed error BEFORE the commit point), reads
+        # go through the full ctx.sql path where admission faults, refresh
+        # faults, and the scheduler apply.
         rng = random.Random(args.seed * 1000 + 7777)
         oracle = tm.copy()
         while time.monotonic() < t_end:
@@ -287,7 +314,10 @@ def main(argv=None) -> int:
                     "k": [rng.randrange(20) for _ in range(8)],
                     "v": [round(rng.random() * 10, 3) for _ in range(8)],
                 })
-                ctx.append_rows("tm", add)
+                try:
+                    ctx.append_rows("tm", add)
+                except res.ResilienceError:
+                    continue  # rejected pre-commit: oracle unchanged
                 oracle = pd.concat([oracle, add], ignore_index=True)
                 continue
             expected = oracle.groupby("k", as_index=False).agg(
@@ -336,7 +366,10 @@ def main(argv=None) -> int:
                     "k": [rng.randrange(20) for _ in range(8)],
                     "v": [round(rng.random() * 10, 3) for _ in range(8)],
                 })
-                ctx.append_rows("ta", add)
+                try:
+                    ctx.append_rows("ta", add)
+                except res.ResilienceError:
+                    continue  # rejected pre-commit: oracle unchanged
                 oracle = pd.concat([oracle, add], ignore_index=True)
                 continue
             expected = oracle.groupby("k", as_index=False).agg(
@@ -369,6 +402,149 @@ def main(argv=None) -> int:
                 continue
             with lock:
                 stats["ok"] += 1
+
+    def ingest_join_client() -> None:
+        # the WAL-armed dashboard pair, wire flavor: appends go through
+        # POST /v1/ingest (tenant-tagged, quota-governed), reads serve the
+        # maintained delta-join view.  The oracle advances only on an
+        # HTTP 200 COMMITTED ack; a faulted/backpressured append is a
+        # typed rejection with nothing durable behind it.
+        rng = random.Random(args.seed * 1000 + 9999)
+        oracle = tij.copy()
+
+        def post(rows):
+            req = urllib.request.Request(
+                f"{base}/v1/ingest",
+                data=json.dumps({"table": "tij", "rows": rows}).encode(),
+                method="POST", headers={"X-DSQL-Tenant": "web"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read())
+
+        while time.monotonic() < t_end:
+            if rng.random() < 0.4:
+                rows = [[rng.randrange(20), round(rng.random() * 10, 3)]
+                        for _ in range(6)]
+                with lock:
+                    ing["appends"] += 1
+                try:
+                    resp = post(rows)
+                except urllib.error.HTTPError as e:
+                    try:
+                        err = json.loads(e.read()).get("error", {})
+                    except Exception:  # noqa: BLE001
+                        err = {}
+                    with lock:
+                        if err.get("errorName"):
+                            ing["rejected"] += 1
+                        else:
+                            ing["untyped"] += 1
+                            problems.append("untyped ingest wire failure: "
+                                            f"HTTP {e.code} without an "
+                                            "errorName")
+                    if e.code == 429:
+                        time.sleep(0.2)
+                    continue
+                except Exception as e:  # noqa: BLE001 - the gate records it
+                    with lock:
+                        ing["untyped"] += 1
+                        problems.append("untyped ingest-writer failure: "
+                                        f"{type(e).__name__}: {e}")
+                    continue
+                with lock:
+                    if resp.get("state") != "COMMITTED":
+                        ing["untyped"] += 1
+                        problems.append(f"unexpected ingest ack: {resp}")
+                        continue
+                    ing["committed"] += 1
+                oracle = pd.concat(
+                    [oracle, pd.DataFrame(rows, columns=["k", "v"])],
+                    ignore_index=True)
+                continue
+            expected = oracle.merge(tdj, on="k")[["k", "v", "c"]]
+            pr = PRIORITIES[rng.randrange(len(PRIORITIES))]
+            with lock:
+                stats["submitted"] += 1
+            try:
+                got = ctx.sql("SELECT * FROM vji", return_futures=False,
+                              timeout=QUERY_TIMEOUT_S, priority=pr)
+            except res.ResilienceError:
+                with lock:
+                    stats["typed"] += 1
+                continue
+            except Exception as e:  # noqa: BLE001 - the gate records it
+                with lock:
+                    stats["untyped"] += 1
+                    problems.append(f"untyped {type(e).__name__} on the "
+                                    f"delta-join view read: {e}")
+                continue
+            try:
+                pd.testing.assert_frame_equal(
+                    _norm(got), _norm(expected), check_dtype=False,
+                    rtol=1e-6, atol=1e-9)
+            except AssertionError as e:
+                with lock:
+                    stats["wrong"] += 1
+                    problems.append("WRONG RESULT on the delta-join view "
+                                    f"(stale or corrupt): {str(e)[:300]}")
+                continue
+            with lock:
+                stats["ok"] += 1
+        ing_state["tij"] = oracle
+
+    def ingest_distinct_client() -> None:
+        # in-process flavor over a COUNT(DISTINCT) view (refcounted value
+        # state): single mutator of tdc, so the nunique oracle is exact
+        rng = random.Random(args.seed * 1000 + 6666)
+        oracle = tdc.copy()
+        while time.monotonic() < t_end:
+            if rng.random() < 0.4:
+                add = pd.DataFrame(
+                    {"k": [rng.randrange(50) for _ in range(5)]})
+                with lock:
+                    ing["appends"] += 1
+                try:
+                    ctx.append_rows("tdc", add)
+                except res.ResilienceError:
+                    with lock:
+                        ing["rejected"] += 1
+                    continue
+                except Exception as e:  # noqa: BLE001 - the gate records it
+                    with lock:
+                        ing["untyped"] += 1
+                        problems.append("untyped ingest append failure: "
+                                        f"{type(e).__name__}: {e}")
+                    continue
+                with lock:
+                    ing["committed"] += 1
+                oracle = pd.concat([oracle, add], ignore_index=True)
+                continue
+            expected_n = int(oracle["k"].nunique())
+            pr = PRIORITIES[rng.randrange(len(PRIORITIES))]
+            with lock:
+                stats["submitted"] += 1
+            try:
+                got = ctx.sql("SELECT n FROM vdc", return_futures=False,
+                              timeout=QUERY_TIMEOUT_S, priority=pr)
+            except res.ResilienceError:
+                with lock:
+                    stats["typed"] += 1
+                continue
+            except Exception as e:  # noqa: BLE001 - the gate records it
+                with lock:
+                    stats["untyped"] += 1
+                    problems.append(f"untyped {type(e).__name__} on the "
+                                    f"COUNT(DISTINCT) view read: {e}")
+                continue
+            if int(got["n"][0]) != expected_n:
+                with lock:
+                    stats["wrong"] += 1
+                    problems.append("WRONG RESULT on the COUNT(DISTINCT) "
+                                    f"view: {int(got['n'][0])} != "
+                                    f"{expected_n}")
+                continue
+            with lock:
+                stats["ok"] += 1
+        ing_state["tdc"] = oracle
 
     def paging_client() -> None:
         # the wire-level tenant: pages 2000-row results through the spool
@@ -460,6 +636,9 @@ def main(argv=None) -> int:
                for i in range(args.clients)]
     threads.append(threading.Thread(target=mv_client, daemon=True))
     threads.append(threading.Thread(target=autopilot_client, daemon=True))
+    threads.append(threading.Thread(target=ingest_join_client, daemon=True))
+    threads.append(threading.Thread(target=ingest_distinct_client,
+                                    daemon=True))
     threads.append(threading.Thread(target=paging_client, daemon=True))
     for th in threads:
         th.start()
@@ -518,6 +697,11 @@ def main(argv=None) -> int:
     if http["abandoned"] == 0:
         failures.append("no pagination was abandoned — the reaper was "
                         "never exercised")
+    if ing["untyped"]:
+        failures.append(f"{ing['untyped']} untyped ingest failure(s)")
+    if ing["committed"] == 0:
+        failures.append("no ingest batch committed — the WAL writer was "
+                        "never exercised")
 
     # scheduler reconciliation: every submission enters admission exactly
     # once and leaves as admitted | rejected | timeout | injected fault
@@ -567,6 +751,26 @@ def main(argv=None) -> int:
             failures.append(f"post-soak health check failed on {sql!r}: "
                             f"{type(e).__name__}: {str(e)[:200]}")
 
+    # the maintained ingest views must end EXACTLY at the acked prefix:
+    # every committed batch visible, every rejected one absent
+    try:
+        if "tij" in ing_state:
+            want = ing_state["tij"].merge(tdj, on="k")[["k", "v", "c"]]
+            got = ctx.sql("SELECT * FROM vji", return_futures=False,
+                          timeout=QUERY_TIMEOUT_S)
+            pd.testing.assert_frame_equal(_norm(got), _norm(want),
+                                          check_dtype=False, rtol=1e-6,
+                                          atol=1e-9)
+        if "tdc" in ing_state:
+            got = ctx.sql("SELECT n FROM vdc", return_futures=False,
+                          timeout=QUERY_TIMEOUT_S)
+            if int(got["n"][0]) != int(ing_state["tdc"]["k"].nunique()):
+                raise AssertionError("COUNT(DISTINCT) drifted from the "
+                                     "acked oracle")
+    except Exception as e:  # noqa: BLE001 - the gate records it
+        failures.append("post-soak ingest-view audit failed: "
+                        f"{type(e).__name__}: {str(e)[:300]}")
+
     # spill hygiene: every grace run is freed on success AND error paths —
     # a surviving run after all clients joined is a leak
     from dask_sql_tpu.runtime import spill as spill_mod
@@ -600,6 +804,10 @@ def main(argv=None) -> int:
           f"{http['untyped']} untyped; "
           f"{d('result_pages_served')} pages served, "
           f"{d('result_reaped')} reaped")
+    print(f"  ingest: {ing['appends']} appends -> {ing['committed']} "
+          f"committed, {ing['rejected']} rejected (typed), "
+          f"{ing['untyped']} untyped; "
+          f"wal_bytes={int(tel.REGISTRY.gauges().get('ingest_wal_bytes', 0))}")
     print("  admission: "
           f"admitted={admitted} rejected={rejected} timeout={timeout} "
           f"injected={adm_faults} tenant_rejects={ten_rejects} "

@@ -147,6 +147,15 @@ echo "=== [2r] autopilot smoke (closed loop: watchtower -> optimizer) ==="
 # bit-for-bit silent baseline
 python scripts/autopilot_smoke.py
 
+echo "=== [2s] ingest smoke (WAL-backed continuous ingestion) ==="
+# sustained appends must keep delta-join and COUNT(DISTINCT) views
+# oracle-exact with every refresh incremental (>=5x faster than the
+# defining recompute), readers must never see a partial batch or two
+# prefixes in one query, kill -9 must lose zero acked batches (WAL
+# replay), and DSQL_INGEST=0 / an unset dir must never even import the
+# ingest module
+python scripts/ingest_smoke.py
+
 echo "=== [3/4] mesh suites (8 virtual devices) + 2-process multihost ==="
 python -m pytest tests/integration/test_distributed.py \
                  tests/integration/test_tpch_mesh.py \
