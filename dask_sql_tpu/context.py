@@ -14,6 +14,7 @@ from __future__ import annotations
 import itertools
 import logging
 import os
+import threading
 from typing import Any, Callable, List, Optional, Tuple, Union
 
 from .datacontainer import FunctionDescription, SchemaContainer, TableEntry
@@ -78,6 +79,13 @@ class Context:
         # serve a stale cached result
         self._table_epochs: dict = {}
         self._epoch_counter = itertools.count(1)
+        # per-table append serialization: _apply_delta's read-concat-swap
+        # must not interleave between two writers on the same table (the
+        # later swap would discard the earlier batch's rows); the ingest
+        # log holds the same lock across its WAL write so WAL order
+        # matches apply order.  RLock: replay calls _apply_delta under it.
+        self._append_locks: dict = {}
+        self._append_locks_guard = threading.Lock()
         # the lazily-materialized builtin "system" schema sentinel
         # (runtime/system_tables.py): created on first system.* resolution;
         # a user schema literally named "system" shadows it
@@ -259,11 +267,19 @@ class Context:
         if _ingest_on():
             # restart path: committed WAL batches recorded against this
             # table in a previous process apply as soon as the base is
-            # re-registered (crash recovery loses zero committed batches)
+            # re-registered (crash recovery loses zero committed batches).
+            # With nothing pending this is a mid-run (re-)register: the
+            # new source supersedes any logged history, so the table's
+            # segments truncate — replaying them onto the fresh base on
+            # a later restart would double-apply rows (and the WAL stays
+            # bounded by re-registration instead of growing forever).
             try:
                 from .runtime import ingest as _ing
                 log = _ing.get_log(self, create=True)
-                log.maybe_replay(schema_name, table_name.lower())
+                if log.has_pending(schema_name, table_name.lower()):
+                    log.maybe_replay(schema_name, table_name.lower())
+                else:
+                    log.truncate(schema_name, table_name.lower())
             except Exception:
                 logger.debug("ingest replay failed", exc_info=True)
         logger.debug("Registered table %s.%s (%d rows)", schema_name,
@@ -278,6 +294,17 @@ class Context:
             reg.discard_view(schema_name, table_name.lower())
         del self.schema[schema_name].tables[table_name.lower()]
         self.bump_table_epoch(schema_name, table_name)
+        if _ingest_on():
+            # the table's WAL history dies with it: replaying old deltas
+            # into a future table registered under the same name would
+            # resurrect dropped rows
+            try:
+                from .runtime import ingest as _ing
+                log = _ing.get_log(self)
+                if log is not None:
+                    log.truncate(schema_name, table_name.lower())
+            except Exception:
+                logger.debug("ingest truncate failed", exc_info=True)
 
     def alter_schema(self, old_schema_name, new_schema_name):
         reg = self.__dict__.get("_matview_registry")
@@ -357,13 +384,34 @@ class Context:
             return log.commit(schema_name, table_name.lower(), delta)
         return self._apply_delta(schema_name, table_name.lower(), delta)
 
+    def _append_lock(self, schema_name: str, table_name: str):
+        """The per-(schema, table) lock every append takes across its whole
+        read-concat-swap (and, under an armed ingest log, across the WAL
+        write too, so WAL order matches apply order)."""
+        key = (schema_name, table_name.lower())
+        with self._append_locks_guard:
+            lock = self._append_locks.get(key)
+            if lock is None:
+                lock = self._append_locks[key] = threading.RLock()
+            return lock
+
     def _apply_delta(self, schema_name: str, table_name: str,
                      delta: Table) -> int:
         """Make one coerced batch visible: new catalog entry + delta-carrying
         epoch bump.  The tail of the pre-ingest ``append_rows``; the ingest
         log calls it after the WAL write (and on replay).  Re-fetches the
         entry and re-coerces — under micro-batching the table may have been
-        swapped (or its schema altered) since the batch was coerced."""
+        swapped (or its schema altered) since the batch was coerced.
+
+        Serialized per table: concurrent appends (ThreadingHTTPServer runs
+        /v1/ingest handlers concurrently) each read the entry, concat, and
+        swap under ``_append_lock`` — without it two writers read the same
+        entry and the later swap silently discards the earlier batch."""
+        with self._append_lock(schema_name, table_name):
+            return self._apply_delta_locked(schema_name, table_name, delta)
+
+    def _apply_delta_locked(self, schema_name: str, table_name: str,
+                            delta: Table) -> int:
         from .ops.join import concat_tables
         from .runtime.resilience import UserError
         from .runtime.statistics import collect_table_stats

@@ -12,12 +12,18 @@ The write path (``Context.append_rows``, which INSERT INTO and
     coerce -> fault site -> backpressure -> [buffer] -> WAL -> apply
 
 * **WAL**: one newline-terminated JSON envelope per committed batch,
-  written with a single ``os.write`` on an ``O_APPEND`` fd — the commit
-  point.  A crash mid-write leaves a torn tail that fails the CRC/JSON
-  check and is skipped on replay: a batch is committed iff its line is
-  whole, so replay recovers exactly the committed prefix and nothing
-  half-written ("degraded never wrong").  Segments rotate per table at
-  ``DSQL_INGEST_SEGMENT_MB``.
+  written with a single ``os.write`` on an ``O_APPEND`` fd and fsynced —
+  the commit point — so an ack survives OS crash/power loss, not just
+  process death (``DSQL_INGEST_FSYNC=0`` trades that down to
+  process-crash-only durability for throughput).  A crash mid-write
+  leaves a torn tail that fails the CRC/JSON check and is skipped on
+  replay: a batch is committed iff its line is whole, so replay recovers
+  exactly the committed prefix and nothing half-written ("degraded never
+  wrong").  Segments rotate per table at ``DSQL_INGEST_SEGMENT_MB``, and
+  a table's segments truncate when it is dropped or re-registered from
+  source mid-run — the new base supersedes the log (this is the
+  checkpoint story: persist the table to its source, re-register, and
+  the history is gone instead of replaying forever).
 * **Replay**: arming (``Context.__init__`` / ``run_server``) loads the
   log; batches for tables that already exist apply immediately, the
   rest wait for ``create_table`` to re-register the base and then apply
@@ -88,6 +94,11 @@ def batch_ms() -> float:
                    0.0)
     except ValueError:
         return 25.0
+
+
+def _fsync_on() -> bool:
+    return os.environ.get("DSQL_INGEST_FSYNC", "1").strip() \
+        not in ("0", "false")
 
 
 def _segment_bytes() -> int:
@@ -161,12 +172,25 @@ def _table_nbytes(t) -> int:
 # ---------------------------------------------------------------------------
 
 class _Buffer:
-    __slots__ = ("tables", "rows", "born")
+    __slots__ = ("tables", "rows", "born", "grants")
 
     def __init__(self):
         self.tables = []
         self.rows = 0
         self.born = time.monotonic()
+        # (ledger, grant) per buffered batch: the memory-broker
+        # reservation stays alive while the rows sit here — they occupy
+        # real memory until the flush applies them — so trickle writers
+        # cannot park unbounded bytes outside the backpressure budget
+        self.grants = []
+
+    def release_grants(self) -> None:
+        grants, self.grants = self.grants, []
+        for ledger, grant in grants:
+            try:
+                ledger.release(grant)
+            except Exception:  # pragma: no cover
+                logger.debug("ingest: grant release failed", exc_info=True)
 
 
 class _Flusher(threading.Thread):
@@ -226,15 +250,24 @@ class IngestLog:
         return ent[0]
 
     def _wal_write(self, key, delta) -> None:
-        """The commit point: one line, one write syscall.  A crash that
-        truncates the line leaves an invalid tail replay skips."""
+        """The commit point: one line, one write syscall, one fsync.  A
+        crash that truncates the line leaves an invalid tail replay skips;
+        the fsync makes an acked batch survive OS crash/power loss, not
+        just process death (DSQL_INGEST_FSYNC=0 drops it for throughput,
+        degrading the guarantee to process-crash-only durability)."""
         payload = json.dumps(
             {"s": key[0], "t": key[1], "d": _encode_table(delta)},
             separators=(",", ":"))
         line = (json.dumps(
             {"v": WAL_VERSION, "crc": zlib.crc32(payload.encode()),
              "p": payload}, separators=(",", ":")) + "\n").encode()
-        os.write(self._fd_for(key), line)
+        fd = self._fd_for(key)
+        os.write(fd, line)
+        if _fsync_on():
+            try:
+                os.fsync(fd)
+            except OSError:  # pragma: no cover - e.g. fs without fsync
+                logger.debug("ingest: WAL fsync failed", exc_info=True)
         self._wal_bytes += len(line)
         _tel.REGISTRY.set_gauge("ingest_wal_bytes", self._wal_bytes)
 
@@ -316,29 +349,43 @@ class IngestLog:
                 "does not fit the device budget; back off and retry "
                 "(DSQL_DEVICE_BUDGET_MB prices writers and readers from "
                 "the same ledger)", retry_after_s=0.25)
-        try:
-            if batch_rows() > 1:
+        if batch_rows() > 1:
+            handed_off = False
+            try:
                 with self.lock:
                     buf = self._buffers.setdefault(key, _Buffer())
                     buf.tables.append(delta)
                     buf.rows += delta.num_rows
-                    full = buf.rows >= batch_rows()
-                    if not full:
+                    # the buffer owns the reservation from here: buffered
+                    # rows occupy memory until the flush applies them, so
+                    # the grant releases in _flush, not on ack
+                    buf.grants.append((ledger, grant))
+                    handed_off = True
+                    if buf.rows < batch_rows():
                         _tel.inc("ingest_batches_buffered")
                         st = self._stats.setdefault(key, _new_stats())
                         st["buffered_rows"] = buf.rows
                         _tel.REGISTRY.set_gauge(
                             "ingest_buffered_rows", self._buffered_rows())
                         return 0
-                return self._flush(key)
+            finally:
+                if not handed_off:
+                    ledger.release(grant)
+            return self._flush(key)
+        try:
             return self._commit_now(key, delta)
         finally:
             ledger.release(grant)
 
     def _commit_now(self, key, delta) -> int:
-        with self.lock:
-            self._wal_write(key, delta)
-        rows = self.context._apply_delta(key[0], key[1], delta)
+        # the table's append lock spans WAL write AND apply so (a) two
+        # concurrent writers cannot interleave read-concat-swap and lose
+        # a batch, and (b) WAL order is apply order — replay reproduces
+        # exactly the sequence readers observed
+        with self.context._append_lock(key[0], key[1]):
+            with self.lock:
+                self._wal_write(key, delta)
+            rows = self.context._apply_delta_locked(key[0], key[1], delta)
         _tel.inc("ingest_batches_committed")
         _tel.inc("ingest_rows_committed", rows)
         st = self._stats.setdefault(key, _new_stats())
@@ -351,6 +398,8 @@ class IngestLog:
         with self.lock:
             buf = self._buffers.pop(key, None)
             if buf is None or not buf.tables:
+                if buf is not None:
+                    buf.release_grants()
                 return 0
             delta = (buf.tables[0] if len(buf.tables) == 1
                      else concat_tables(buf.tables))
@@ -358,8 +407,11 @@ class IngestLog:
             st["buffered_rows"] = 0
             _tel.REGISTRY.set_gauge("ingest_buffered_rows",
                                     self._buffered_rows())
-        _tel.inc("ingest_flushes")
-        return self._commit_now(key, delta)
+        try:
+            _tel.inc("ingest_flushes")
+            return self._commit_now(key, delta)
+        finally:
+            buf.release_grants()
 
     def flush_aged(self) -> int:
         """Flusher-thread entry: commit buffers older than the batch
@@ -393,6 +445,13 @@ class IngestLog:
         if self._flusher is not None:
             self._flusher.stop.set()
             self._flusher = None
+        # buffered rows were acked BUFFERED over the wire; a graceful
+        # close must commit them before the fds go away or the accepted
+        # batch silently vanishes (the drain path calls this too)
+        try:
+            self.flush_all()
+        except Exception:
+            logger.warning("ingest: flush on close failed", exc_info=True)
         with self.lock:
             for fd, _path, _seq in self._fds.values():
                 try:
@@ -400,6 +459,48 @@ class IngestLog:
                 except OSError:  # pragma: no cover
                     pass
             self._fds.clear()
+
+    def has_pending(self, schema_name: str, table_name: str) -> bool:
+        """True when replayable WAL batches await this table's
+        registration (the restart path)."""
+        with self.lock:
+            return (schema_name, table_name) in self._replay
+
+    def truncate(self, schema_name: str, table_name: str) -> None:
+        """Drop a table's WAL history: segments, buffers, pending replay.
+
+        Called when the base is dropped or re-registered from source with
+        nothing pending — the new (or absent) base supersedes the log, and
+        replaying the old deltas on a later restart would double-apply
+        rows the source now carries, or resurrect a dropped table's rows.
+        Re-registration is also the checkpoint/compaction path: persist
+        the table to its source and re-register, and the WAL stops
+        growing instead of replaying the full history every restart."""
+        key = (schema_name, table_name)
+        with self.lock:
+            ent = self._fds.pop(key, None)
+            if ent is not None:
+                try:
+                    os.close(ent[0])
+                except OSError:  # pragma: no cover
+                    pass
+            buf = self._buffers.pop(key, None)
+            if buf is not None:
+                buf.release_grants()
+            self._replay.pop(key, None)
+            removed = 0
+            for seg in _glob.glob(self._seg_glob(key)):
+                try:
+                    removed += os.path.getsize(seg)
+                    os.remove(seg)
+                except OSError:  # pragma: no cover
+                    pass
+            if removed:
+                self._wal_bytes = max(self._wal_bytes - removed, 0)
+                _tel.REGISTRY.set_gauge("ingest_wal_bytes", self._wal_bytes)
+                _tel.inc("ingest_wal_truncations")
+                logger.info("ingest: truncated %d WAL byte(s) for %s.%s",
+                            removed, schema_name, table_name)
 
     def tables_snapshot(self) -> dict:
         with self.lock:

@@ -866,6 +866,18 @@ def _drain_and_shutdown(server, state: _AppState,
                            and time.monotonic() < grace):
                         time.sleep(0.05)
     finally:
+        if _ingest_on():
+            # micro-batched rows acked BUFFERED are not yet in the WAL;
+            # a graceful drain commits them (WAL + apply) before the
+            # process exits — only a crash may lose buffered (never
+            # committed) batches
+            try:
+                from ..runtime import ingest as _ing
+                log = _ing.get_log(state.context)
+                if log is not None:
+                    log.flush_all()
+            except Exception:
+                logger.exception("ingest flush during drain failed")
         try:
             server.shutdown()
             server.server_close()
